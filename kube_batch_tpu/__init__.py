@@ -1,0 +1,23 @@
+"""tpu-batch: a TPU-native batch/gang scheduler.
+
+Capability surface of kube-batch (gang scheduling, multi-tenant queues, DRF /
+proportional fair share, priority, preemption, reclaim, backfill, action/plugin
+policy engine), with the per-task greedy allocate loop replaced by a batched
+assignment solve on TPU via JAX/XLA.
+
+Package layout (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``api``        — in-memory domain model (reference: pkg/scheduler/api)
+- ``cache``      — cluster mirror + snapshot + bind/evict seams (pkg/scheduler/cache)
+- ``framework``  — Session, Statement, plugin/action registries (pkg/scheduler/framework)
+- ``plugins``    — gang, drf, proportion, priority, predicates, nodeorder, conformance
+- ``actions``    — allocate, allocate_tpu, backfill, preempt, reclaim
+- ``ops``        — JAX kernels: feasibility masks, scoring, batched assignment solver
+- ``parallel``   — device mesh / sharding for multi-chip solves
+- ``utils``      — priority queue, scheduler helpers
+- ``metrics``    — scheduling latency/counter metrics
+- ``conf``       — scheduler policy configuration (YAML-compatible with kube-batch-conf.yaml)
+- ``cli``        — process entry point, flags, metrics server
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,64 @@
+"""In-memory domain model (mirrors reference pkg/scheduler/api)."""
+
+from .cluster_info import ClusterInfo
+from .helpers import get_controller_uid, get_task_status, pod_key
+from .job_info import JobID, JobInfo, QueueID, TaskID, TaskInfo, get_job_id
+from .node_info import NodeInfo, NodeState
+from .objects import (
+    DEFAULT_SCHEDULER_NAME,
+    GROUP_NAME_ANNOTATION_KEY,
+    NOT_ENOUGH_PODS_REASON,
+    NOT_ENOUGH_RESOURCES_REASON,
+    POD_GROUP_CONDITION_UNSCHEDULABLE,
+    Affinity,
+    Container,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodGroup,
+    PodGroupCondition,
+    PodGroupPhase,
+    PodGroupSpec,
+    PodGroupStatus,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    PriorityClass,
+    Queue,
+    QueueSpec,
+    QueueStatus,
+    Taint,
+    Toleration,
+    generate_uid,
+)
+from .pod_info import (
+    get_pod_resource_request,
+    get_pod_resource_without_init_containers,
+)
+from .resource_info import (
+    GPU_RESOURCE_NAME,
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    MIN_MILLI_SCALAR,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    TPU_RESOURCE_NAME,
+    Resource,
+    ResourceList,
+    build_resource_list,
+    min_resource,
+    parse_quantity,
+    share,
+)
+from .types import (
+    ALLOCATED_STATUSES,
+    NodePhase,
+    TaskStatus,
+    ValidateResult,
+    allocated_status,
+)

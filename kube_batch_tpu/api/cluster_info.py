@@ -1,0 +1,27 @@
+"""ClusterInfo: the per-cycle snapshot type.
+
+Mirrors reference pkg/scheduler/api/cluster_info.go:21-26.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .job_info import JobID, JobInfo
+from .node_info import NodeInfo
+from .queue_info import QueueID, QueueInfo
+
+
+class ClusterInfo:
+    """A snapshot of cluster state used by one scheduling Session."""
+
+    def __init__(self):
+        self.jobs: Dict[JobID, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[QueueID, QueueInfo] = {}
+
+    def __repr__(self) -> str:
+        return (
+            f"ClusterInfo(jobs={len(self.jobs)}, nodes={len(self.nodes)}, "
+            f"queues={len(self.queues)})"
+        )

@@ -1,0 +1,45 @@
+"""Domain-model helpers.
+
+Mirrors reference pkg/scheduler/api/helpers.go (:26 PodKey, :35 getTaskStatus)
+and pkg/apis/utils/utils.go (:26 GetController).
+"""
+
+from __future__ import annotations
+
+from .objects import Pod, PodPhase
+from .types import TaskStatus
+
+
+def pod_key(pod: Pod) -> str:
+    """Unique key of a pod (reference helpers.go:26-33)."""
+    if pod.metadata.uid:
+        return pod.metadata.uid
+    return f"{pod.namespace}/{pod.name}"
+
+
+def get_task_status(pod: Pod) -> TaskStatus:
+    """Pod phase → TaskStatus (reference helpers.go:35-60)."""
+    phase = pod.status.phase
+    if phase == PodPhase.RUNNING:
+        if pod.metadata.deletion_timestamp is not None:
+            return TaskStatus.RELEASING
+        return TaskStatus.RUNNING
+    if phase == PodPhase.PENDING:
+        if pod.metadata.deletion_timestamp is not None:
+            return TaskStatus.RELEASING
+        if pod.spec.node_name:
+            return TaskStatus.BOUND
+        return TaskStatus.PENDING
+    if phase == PodPhase.UNKNOWN:
+        return TaskStatus.UNKNOWN
+    if phase == PodPhase.SUCCEEDED:
+        return TaskStatus.SUCCEEDED
+    if phase == PodPhase.FAILED:
+        return TaskStatus.FAILED
+    return TaskStatus.UNKNOWN
+
+
+def get_controller_uid(pod: Pod) -> str:
+    """Controller owner UID, used to key shadow PodGroups
+    (reference apis/utils/utils.go:26-38)."""
+    return pod.metadata.owner_uid or ""

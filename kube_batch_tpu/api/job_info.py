@@ -1,0 +1,262 @@
+"""TaskInfo and JobInfo: the per-pod and per-gang scheduling state.
+
+Mirrors reference pkg/scheduler/api/job_info.go:
+- TaskInfo (:36) with Resreq (running requirement) vs InitResreq (launch
+  requirement, includes init-container max).
+- JobInfo (:127) with a status-indexed task map, MinAvailable gang threshold,
+  NodesFitDelta fit diagnostics, Ready/Pipelined gang readiness (:415,:422).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .helpers import get_task_status, pod_key
+from .objects import (
+    GROUP_NAME_ANNOTATION_KEY,
+    Pod,
+    PodGroup,
+)
+from .pod_info import (
+    get_pod_resource_request,
+    get_pod_resource_without_init_containers,
+)
+from .resource_info import RESOURCE_CPU, RESOURCE_MEMORY, Resource
+from .types import TaskStatus, allocated_status, validate_status_update
+
+TaskID = str
+JobID = str
+QueueID = str
+
+
+def get_job_id(pod: Pod) -> JobID:
+    """Pod → owning job key via group-name annotation
+    (reference job_info.go:56-66)."""
+    gn = pod.metadata.annotations.get(GROUP_NAME_ANNOTATION_KEY, "")
+    if gn:
+        return f"{pod.namespace}/{gn}"
+    return ""
+
+
+class TaskInfo:
+    """All scheduling info about one task (reference job_info.go:36-54)."""
+
+    __slots__ = (
+        "uid",
+        "job",
+        "name",
+        "namespace",
+        "resreq",
+        "init_resreq",
+        "node_name",
+        "status",
+        "priority",
+        "volume_ready",
+        "pod",
+    )
+
+    def __init__(self, pod: Pod):
+        self.uid: TaskID = pod.metadata.uid
+        self.job: JobID = get_job_id(pod)
+        self.name = pod.name
+        self.namespace = pod.namespace
+        self.node_name = pod.spec.node_name
+        self.status = get_task_status(pod)
+        self.priority: int = (
+            pod.spec.priority if pod.spec.priority is not None else 1
+        )
+        self.volume_ready = False
+        self.pod = pod
+        self.resreq: Resource = get_pod_resource_without_init_containers(pod)
+        self.init_resreq: Resource = get_pod_resource_request(pod)
+
+    def clone(self) -> "TaskInfo":
+        c = object.__new__(TaskInfo)
+        c.uid = self.uid
+        c.job = self.job
+        c.name = self.name
+        c.namespace = self.namespace
+        c.node_name = self.node_name
+        c.status = self.status
+        c.priority = self.priority
+        c.volume_ready = self.volume_ready
+        c.pod = self.pod
+        c.resreq = self.resreq.clone()
+        c.init_resreq = self.init_resreq.clone()
+        return c
+
+    @property
+    def best_effort(self) -> bool:
+        """A task with an empty resource request (allocate.go:108-113 skips
+        these; backfill.go:45 targets them)."""
+        return self.resreq.is_empty()
+
+    def __repr__(self) -> str:
+        return (
+            f"Task ({self.uid}:{self.namespace}/{self.name}): job {self.job}, "
+            f"status {self.status.name}, pri {self.priority}, resreq {self.resreq}"
+        )
+
+
+class JobInfo:
+    """All scheduling info about one job/gang (reference job_info.go:127-154)."""
+
+    def __init__(self, uid: JobID, *tasks: TaskInfo):
+        self.uid = uid
+        self.name = ""
+        self.namespace = ""
+        self.queue: QueueID = ""
+        self.priority: int = 0
+        self.min_available: int = 0
+        self.node_selector: Dict[str, str] = {}
+        self.nodes_fit_delta: Dict[str, Resource] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[TaskID, TaskInfo]] = {}
+        self.tasks: Dict[TaskID, TaskInfo] = {}
+        self.allocated = Resource.empty()
+        self.total_request = Resource.empty()
+        self.creation_timestamp: float = 0.0
+        self.pod_group: Optional[PodGroup] = None
+        for task in tasks:
+            self.add_task_info(task)
+
+    # -- pod group ----------------------------------------------------------
+
+    def set_pod_group(self, pg: PodGroup) -> None:
+        """Attach PodGroup spec to the job (reference job_info.go:184-192)."""
+        self.name = pg.name
+        self.namespace = pg.namespace
+        self.min_available = pg.spec.min_member
+        self.queue = pg.spec.queue
+        self.creation_timestamp = pg.metadata.creation_timestamp
+        self.pod_group = pg
+
+    def unset_pod_group(self) -> None:
+        self.pod_group = None
+
+    # -- task bookkeeping ---------------------------------------------------
+
+    def _add_task_index(self, ti: TaskInfo) -> None:
+        self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
+
+    def _delete_task_index(self, ti: TaskInfo) -> None:
+        tasks = self.task_status_index.get(ti.status)
+        if tasks is not None:
+            tasks.pop(ti.uid, None)
+            if not tasks:
+                del self.task_status_index[ti.status]
+
+    def add_task_info(self, ti: TaskInfo) -> None:
+        """reference job_info.go:233-242"""
+        self.tasks[ti.uid] = ti
+        self._add_task_index(ti)
+        self.total_request.add(ti.resreq)
+        if allocated_status(ti.status):
+            self.allocated.add(ti.resreq)
+
+    def delete_task_info(self, ti: TaskInfo) -> None:
+        """reference job_info.go:271-287"""
+        task = self.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(
+                f"failed to find task <{ti.namespace}/{ti.name}> "
+                f"in job <{self.namespace}/{self.name}>"
+            )
+        self.total_request.sub(task.resreq)
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        del self.tasks[task.uid]
+        self._delete_task_index(task)
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        """Delete + re-add under the new status index
+        (reference job_info.go:245-258)."""
+        validate_status_update(task.status, status)
+        self.delete_task_info(task)
+        task.status = status
+        self.add_task_info(task)
+
+    def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
+        """Clones of all tasks in the given statuses (reference :210-222)."""
+        res: List[TaskInfo] = []
+        for status in statuses:
+            for task in self.task_status_index.get(status, {}).values():
+                res.append(task.clone())
+        return res
+
+    def clone(self) -> "JobInfo":
+        """reference job_info.go:290-322"""
+        info = JobInfo(self.uid)
+        info.name = self.name
+        info.namespace = self.namespace
+        info.queue = self.queue
+        info.priority = self.priority
+        info.min_available = self.min_available
+        info.node_selector = dict(self.node_selector)
+        info.creation_timestamp = self.creation_timestamp
+        info.pod_group = self.pod_group
+        for task in self.tasks.values():
+            info.add_task_info(task.clone())
+        return info
+
+    # -- gang readiness -----------------------------------------------------
+
+    def ready_task_num(self) -> int:
+        """Allocated/Bound/Binding/Running/Succeeded (reference :374-385)."""
+        n = 0
+        for status, tasks in self.task_status_index.items():
+            if allocated_status(status) or status == TaskStatus.SUCCEEDED:
+                n += len(tasks)
+        return n
+
+    def waiting_task_num(self) -> int:
+        """Pipelined tasks (reference :387-397)."""
+        return len(self.task_status_index.get(TaskStatus.PIPELINED, {}))
+
+    def valid_task_num(self) -> int:
+        """Tasks that can still count toward minAvailable (reference :399-412)."""
+        n = 0
+        for status, tasks in self.task_status_index.items():
+            if (
+                allocated_status(status)
+                or status == TaskStatus.SUCCEEDED
+                or status == TaskStatus.PIPELINED
+                or status == TaskStatus.PENDING
+            ):
+                n += len(tasks)
+        return n
+
+    def ready(self) -> bool:
+        """reference :415-419"""
+        return self.ready_task_num() >= self.min_available
+
+    def pipelined(self) -> bool:
+        """reference :422-426"""
+        return self.waiting_task_num() + self.ready_task_num() >= self.min_available
+
+    # -- diagnostics --------------------------------------------------------
+
+    def fit_error(self) -> str:
+        """Human-readable insufficiency histogram (reference :340-372)."""
+        if not self.nodes_fit_delta:
+            return "0 nodes are available"
+        reasons: Dict[str, int] = {}
+        for delta in self.nodes_fit_delta.values():
+            if delta.get(RESOURCE_CPU) < 0:
+                reasons["cpu"] = reasons.get("cpu", 0) + 1
+            if delta.get(RESOURCE_MEMORY) < 0:
+                reasons["memory"] = reasons.get("memory", 0) + 1
+            for name, quant in (delta.scalar_resources or {}).items():
+                if quant < 0:
+                    reasons[name] = reasons.get(name, 0) + 1
+        parts = sorted(f"{v} insufficient {k}" for k, v in reasons.items())
+        return (
+            f"0/{len(self.nodes_fit_delta)} nodes are available, "
+            f"{', '.join(parts)}."
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"Job ({self.uid}): namespace {self.namespace} ({self.queue}), "
+            f"name {self.name}, minAvailable {self.min_available}, "
+            f"tasks {len(self.tasks)}"
+        )

@@ -1,0 +1,157 @@
+"""NodeInfo: per-node aggregated scheduling state.
+
+Mirrors reference pkg/scheduler/api/node_info.go:
+- Releasing / Idle / Used dual accounting (:36-44) so the scheduler can plan
+  onto resources that are still being released ("Pipelined" placements).
+- AddTask status-dependent accounting (:174-206): Releasing → take idle AND
+  count releasing; Pipelined → consume releasing (not idle); default → take
+  idle. RemoveTask is the exact inverse (:209-235).
+- OutOfSync / NotReady state when accounting underflows (:107-131,:161-171).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .helpers import pod_key
+from .job_info import TaskInfo
+from .objects import Node, Pod
+from .resource_info import Resource
+from .types import NodePhase, TaskStatus
+
+
+@dataclass
+class NodeState:
+    phase: str = NodePhase.NOT_READY
+    reason: str = ""
+
+
+class NodeInfo:
+    """Node-level aggregated information (reference node_info.go:28-47)."""
+
+    def __init__(self, node: Optional[Node] = None):
+        self.name = ""
+        self.node: Optional[Node] = None
+        self.state = NodeState()
+        self.releasing = Resource.empty()
+        self.idle = Resource.empty()
+        self.used = Resource.empty()
+        self.allocatable = Resource.empty()
+        self.capability = Resource.empty()
+        self.tasks: Dict[str, TaskInfo] = {}
+        if node is not None:
+            self.name = node.name
+            self.node = node
+            self.idle = Resource.from_resource_list(node.status.allocatable)
+            self.allocatable = Resource.from_resource_list(node.status.allocatable)
+            self.capability = Resource.from_resource_list(node.status.capacity)
+        self._set_node_state(node)
+
+    # -- state --------------------------------------------------------------
+
+    def ready(self) -> bool:
+        return self.state.phase == NodePhase.READY
+
+    def _set_node_state(self, node: Optional[Node]) -> None:
+        """reference node_info.go:107-131"""
+        if node is None:
+            self.state = NodeState(NodePhase.NOT_READY, "UnInitialized")
+            return
+        if not self.used.less_equal(
+            Resource.from_resource_list(node.status.allocatable)
+        ):
+            self.state = NodeState(NodePhase.NOT_READY, "OutOfSync")
+            return
+        self.state = NodeState(NodePhase.READY, "")
+
+    def set_node(self, node: Node) -> None:
+        """Recompute accounting from a fresh node object
+        (reference node_info.go:134-159)."""
+        self._set_node_state(node)
+        if not self.ready():
+            return
+        self.name = node.name
+        self.node = node
+        self.allocatable = Resource.from_resource_list(node.status.allocatable)
+        self.capability = Resource.from_resource_list(node.status.capacity)
+        self.idle = Resource.from_resource_list(node.status.allocatable)
+        self.used = Resource.empty()
+        self.releasing = Resource.empty()
+        for task in self.tasks.values():
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.add(task.resreq)
+            self.idle.sub(task.resreq)
+            self.used.add(task.resreq)
+
+    # -- task accounting ----------------------------------------------------
+
+    def _allocate_idle_resource(self, ti: TaskInfo) -> None:
+        """reference node_info.go:161-171"""
+        if ti.resreq.less_equal(self.idle):
+            self.idle.sub(ti.resreq)
+            return
+        self.state = NodeState(NodePhase.NOT_READY, "OutOfSync")
+        raise ValueError("Selected node NotReady")
+
+    def add_task(self, task: TaskInfo) -> None:
+        """reference node_info.go:174-206; node holds a CLONE of the task so
+        later status changes don't corrupt node accounting (:181-183)."""
+        key = pod_key(task.pod)
+        if key in self.tasks:
+            raise ValueError(
+                f"task <{task.namespace}/{task.name}> already on node <{self.name}>"
+            )
+        ti = task.clone()
+        if self.node is not None:
+            if ti.status == TaskStatus.RELEASING:
+                self._allocate_idle_resource(ti)
+                self.releasing.add(ti.resreq)
+            elif ti.status == TaskStatus.PIPELINED:
+                self.releasing.sub(ti.resreq)
+            else:
+                self._allocate_idle_resource(ti)
+            self.used.add(ti.resreq)
+        self.tasks[key] = ti
+
+    def remove_task(self, ti: TaskInfo) -> None:
+        """reference node_info.go:209-235"""
+        key = pod_key(ti.pod)
+        task = self.tasks.get(key)
+        if task is None:
+            raise KeyError(
+                f"failed to find task <{ti.namespace}/{ti.name}> "
+                f"on host <{self.name}>"
+            )
+        if self.node is not None:
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.sub(task.resreq)
+                self.idle.add(task.resreq)
+            elif task.status == TaskStatus.PIPELINED:
+                self.releasing.add(task.resreq)
+            else:
+                self.idle.add(task.resreq)
+            self.used.sub(task.resreq)
+        del self.tasks[key]
+
+    def update_task(self, ti: TaskInfo) -> None:
+        """reference node_info.go:238-244"""
+        self.remove_task(ti)
+        self.add_task(ti)
+
+    def clone(self) -> "NodeInfo":
+        """reference node_info.go:92-100"""
+        res = NodeInfo(self.node)
+        for task in self.tasks.values():
+            res.add_task(task)
+        return res
+
+    def pods(self) -> List[Pod]:
+        return [t.pod for t in self.tasks.values()]
+
+    def __repr__(self) -> str:
+        return (
+            f"Node ({self.name}): idle <{self.idle}>, used <{self.used}>, "
+            f"releasing <{self.releasing}>, "
+            f"state <phase {self.state.phase}, reason {self.state.reason}>"
+        )

@@ -1,0 +1,156 @@
+"""Builders + fake side-effect seams for cluster-less tests.
+
+Mirrors reference pkg/scheduler/util/test_utils.go:
+- BuildNode/BuildPod/BuildResourceList builders (:33-91).
+- FakeBinder/FakeEvictor record calls into maps + channels (:95-133);
+  FakeStatusUpdater/FakeVolumeBinder no-op (:136-163).
+Used by both the test suite and the synthetic benchmark generators.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional
+
+from ..api import (
+    GROUP_NAME_ANNOTATION_KEY,
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    Queue,
+    QueueSpec,
+    ResourceList,
+    build_resource_list,
+)
+
+
+def build_node(
+    name: str,
+    alloc: ResourceList,
+    labels: Optional[Dict[str, str]] = None,
+    capacity: Optional[ResourceList] = None,
+) -> Node:
+    """reference test_utils.go:33-46"""
+    return Node(
+        metadata=ObjectMeta(name=name, labels=dict(labels or {})),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable=dict(alloc), capacity=dict(capacity or alloc)),
+    )
+
+
+def build_pod(
+    namespace: str,
+    name: str,
+    node_name: str,
+    phase: str,
+    req: ResourceList,
+    group_name: str = "",
+    labels: Optional[Dict[str, str]] = None,
+    selector: Optional[Dict[str, str]] = None,
+    priority: Optional[int] = None,
+    owner_uid: str = "",
+) -> Pod:
+    """reference test_utils.go:49-81"""
+    annotations = {}
+    if group_name:
+        annotations[GROUP_NAME_ANNOTATION_KEY] = group_name
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=f"{namespace}-{name}",
+            labels=dict(labels or {}),
+            annotations=annotations,
+            owner_uid=owner_uid,
+        ),
+        spec=PodSpec(
+            node_name=node_name,
+            node_selector=dict(selector or {}),
+            containers=[Container(requests=dict(req))],
+            priority=priority,
+        ),
+        status=PodStatus(phase=phase),
+    )
+
+
+def build_pod_group(
+    name: str,
+    namespace: str = "default",
+    min_member: int = 1,
+    queue: str = "default",
+    priority_class_name: str = "",
+) -> PodGroup:
+    return PodGroup(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=PodGroupSpec(
+            min_member=min_member,
+            queue=queue,
+            priority_class_name=priority_class_name,
+        ),
+    )
+
+
+def build_queue(name: str, weight: int = 1, capability=None) -> Queue:
+    return Queue(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=QueueSpec(weight=weight, capability=capability),
+    )
+
+
+class FakeBinder:
+    """Records binds (reference test_utils.go:95-114)."""
+
+    def __init__(self):
+        self.binds: Dict[str, str] = {}
+        self.channel: "queue.Queue[str]" = queue.Queue()
+        self._lock = threading.Lock()
+
+    def bind(self, pod: Pod, hostname: str) -> None:
+        with self._lock:
+            key = f"{pod.namespace}/{pod.name}"
+            self.binds[key] = hostname
+            self.channel.put(key)
+
+
+class FakeEvictor:
+    """Records evictions (reference test_utils.go:117-133)."""
+
+    def __init__(self):
+        self.evicts: List[str] = []
+        self.channel: "queue.Queue[str]" = queue.Queue()
+        self._lock = threading.Lock()
+
+    def evict(self, pod: Pod) -> None:
+        with self._lock:
+            key = f"{pod.namespace}/{pod.name}"
+            self.evicts.append(key)
+            self.channel.put(key)
+
+
+class FakeStatusUpdater:
+    """No-op (reference test_utils.go:136-147)."""
+
+    def update_pod_condition(self, pod: Pod, condition) -> None:
+        return None
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        return None
+
+
+class FakeVolumeBinder:
+    """No-op (reference test_utils.go:150-163)."""
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        return None
+
+    def bind_volumes(self, task) -> None:
+        return None

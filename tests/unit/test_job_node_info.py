@@ -1,0 +1,205 @@
+"""JobInfo/NodeInfo/pod-resource tests (port of reference
+api/{job_info,node_info,pod_info}_test.go)."""
+
+import pytest
+
+from kube_batch_tpu.api import (
+    Container,
+    JobInfo,
+    NodeInfo,
+    Pod,
+    PodPhase,
+    Resource,
+    TaskInfo,
+    TaskStatus,
+    build_resource_list,
+    get_pod_resource_request,
+)
+from kube_batch_tpu.utils.test_utils import build_node, build_pod
+
+
+def mk_task(name, node="", phase=PodPhase.PENDING, cpu="1", group="pg1"):
+    pod = build_pod(
+        "ns", name, node, phase, build_resource_list(cpu=cpu, memory="1Gi"), group
+    )
+    return TaskInfo(pod)
+
+
+class TestPodResource:
+    def test_sum_of_containers(self):
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, {})
+        pod.spec.containers = [
+            Container(requests=build_resource_list(cpu="1", memory="1Gi")),
+            Container(requests=build_resource_list(cpu="2", memory="1Gi")),
+        ]
+        r = get_pod_resource_request(pod)
+        assert r.milli_cpu == 3000
+        assert r.memory == 2 * 2**30
+
+    def test_init_container_max_rule(self):
+        # reference pod_info.go:56: request = max(sum(containers), each init)
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, {})
+        pod.spec.containers = [
+            Container(requests=build_resource_list(cpu="1", memory="1Gi"))
+        ]
+        pod.spec.init_containers = [
+            Container(requests=build_resource_list(cpu="4", memory="10Mi"))
+        ]
+        r = get_pod_resource_request(pod)
+        assert r.milli_cpu == 4000  # init container dominates cpu
+        assert r.memory == 2**30  # main containers dominate memory
+
+
+class TestTaskInfo:
+    def test_status_from_phase(self):
+        assert mk_task("a").status == TaskStatus.PENDING
+        assert mk_task("b", node="n1", phase=PodPhase.RUNNING).status == TaskStatus.RUNNING
+        assert mk_task("c", node="n1").status == TaskStatus.BOUND
+
+    def test_releasing_on_deletion(self):
+        t = mk_task("a", node="n1", phase=PodPhase.RUNNING)
+        t.pod.metadata.deletion_timestamp = 1.0
+        assert TaskInfo(t.pod).status == TaskStatus.RELEASING
+
+    def test_job_key_namespaced(self):
+        assert mk_task("a").job == "ns/pg1"
+
+    def test_default_priority(self):
+        assert mk_task("a").priority == 1
+
+    def test_best_effort(self):
+        pod = build_pod("ns", "be", "", PodPhase.PENDING, {})
+        assert TaskInfo(pod).best_effort
+
+
+class TestJobInfo:
+    def test_add_task_indexes_by_status(self):
+        # reference job_info_test.go:35 (AddTaskInfo)
+        t1 = mk_task("t1")
+        t2 = mk_task("t2", node="n1", phase=PodPhase.RUNNING)
+        job = JobInfo("ns/pg1", t1, t2)
+        assert set(job.tasks) == {t1.uid, t2.uid}
+        assert t1.uid in job.task_status_index[TaskStatus.PENDING]
+        assert t2.uid in job.task_status_index[TaskStatus.RUNNING]
+        assert job.allocated.milli_cpu == 1000  # only the running task
+
+    def test_delete_task(self):
+        # reference job_info_test.go:103 (DeleteTaskInfo)
+        t1, t2 = mk_task("t1"), mk_task("t2", node="n1", phase=PodPhase.RUNNING)
+        job = JobInfo("ns/pg1", t1, t2)
+        job.delete_task_info(t1)
+        assert t1.uid not in job.tasks
+        assert TaskStatus.PENDING not in job.task_status_index
+        assert job.total_request.milli_cpu == 1000
+
+    def test_update_task_status_moves_index(self):
+        t1 = mk_task("t1")
+        job = JobInfo("ns/pg1", t1)
+        job.update_task_status(t1, TaskStatus.ALLOCATED)
+        assert TaskStatus.PENDING not in job.task_status_index
+        assert t1.uid in job.task_status_index[TaskStatus.ALLOCATED]
+        assert job.allocated.milli_cpu == 1000
+
+    def test_readiness(self):
+        tasks = [mk_task(f"t{i}") for i in range(3)]
+        job = JobInfo("ns/pg1", *tasks)
+        job.min_available = 2
+        assert not job.ready()
+        job.update_task_status(tasks[0], TaskStatus.ALLOCATED)
+        job.update_task_status(tasks[1], TaskStatus.PIPELINED)
+        assert job.ready_task_num() == 1
+        assert job.waiting_task_num() == 1
+        assert not job.ready()
+        assert job.pipelined()
+        job.update_task_status(tasks[1], TaskStatus.ALLOCATED)
+        assert job.ready()
+
+    def test_valid_task_num_excludes_failed(self):
+        tasks = [mk_task(f"t{i}") for i in range(2)]
+        job = JobInfo("ns/pg1", *tasks)
+        job.update_task_status(tasks[0], TaskStatus.FAILED)
+        assert job.valid_task_num() == 1
+
+    def test_clone_is_deep(self):
+        t1 = mk_task("t1")
+        job = JobInfo("ns/pg1", t1)
+        c = job.clone()
+        c.update_task_status(c.tasks[t1.uid], TaskStatus.ALLOCATED)
+        assert job.tasks[t1.uid].status == TaskStatus.PENDING
+
+
+class TestNodeInfo:
+    def make_node(self, cpu="8", mem="8Gi"):
+        return NodeInfo(build_node("n1", build_resource_list(cpu=cpu, memory=mem)))
+
+    def test_add_remove_task(self):
+        # reference node_info_test.go:35 (AddTask) / :102 (RemoveTask)
+        ni = self.make_node()
+        t = mk_task("t1", node="n1", phase=PodPhase.RUNNING)
+        ni.add_task(t)
+        assert ni.idle.milli_cpu == 7000
+        assert ni.used.milli_cpu == 1000
+        ni.remove_task(t)
+        assert ni.idle.milli_cpu == 8000
+        assert ni.used.milli_cpu == 0
+
+    def test_add_duplicate_raises(self):
+        ni = self.make_node()
+        t = mk_task("t1", node="n1", phase=PodPhase.RUNNING)
+        ni.add_task(t)
+        with pytest.raises(ValueError):
+            ni.add_task(t)
+
+    def test_releasing_accounting(self):
+        # Releasing: takes idle AND counts releasing (node_info.go:186-192)
+        ni = self.make_node()
+        t = mk_task("t1", node="n1", phase=PodPhase.RUNNING)
+        t.pod.metadata.deletion_timestamp = 1.0
+        rel = TaskInfo(t.pod)
+        ni.add_task(rel)
+        assert ni.releasing.milli_cpu == 1000
+        assert ni.idle.milli_cpu == 7000
+        ni.remove_task(rel)
+        assert ni.releasing.milli_cpu == 0
+        assert ni.idle.milli_cpu == 8000
+
+    def test_pipelined_consumes_releasing_not_idle(self):
+        # Pipelined: releasing -= resreq, idle untouched (node_info.go:193)
+        ni = self.make_node()
+        t = mk_task("rel", node="n1", phase=PodPhase.RUNNING)
+        t.pod.metadata.deletion_timestamp = 1.0
+        ni.add_task(TaskInfo(t.pod))
+        p = mk_task("pipe")
+        p.status = TaskStatus.PIPELINED
+        ni.add_task(p)
+        assert ni.releasing.milli_cpu == 0
+        assert ni.idle.milli_cpu == 7000
+        assert ni.used.milli_cpu == 2000
+
+    def test_overcommit_marks_out_of_sync(self):
+        ni = self.make_node(cpu="1")
+        t = mk_task("big", node="n1", phase=PodPhase.RUNNING, cpu="4")
+        with pytest.raises(ValueError):
+            ni.add_task(t)
+        assert not ni.ready()
+        assert ni.state.reason == "OutOfSync"
+
+    def test_node_holds_task_clone(self):
+        # node_info.go:181-183: status change on the original must not
+        # corrupt node accounting
+        ni = self.make_node()
+        t = mk_task("t1", node="n1", phase=PodPhase.RUNNING)
+        ni.add_task(t)
+        t.status = TaskStatus.RELEASING
+        ni.remove_task(t)  # removes via key; uses the stored clone's status
+        assert ni.idle.milli_cpu == 8000
+        assert ni.releasing.milli_cpu == 0
+
+    def test_set_node_recomputes(self):
+        ni = self.make_node()
+        t = mk_task("t1", node="n1", phase=PodPhase.RUNNING)
+        ni.add_task(t)
+        bigger = build_node("n1", build_resource_list(cpu="16", memory="8Gi"))
+        ni.set_node(bigger)
+        assert ni.idle.milli_cpu == 15000
+        assert ni.used.milli_cpu == 1000

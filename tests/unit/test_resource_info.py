@@ -1,0 +1,153 @@
+"""Resource arithmetic tests (port of reference api/resource_info_test.go)."""
+
+import pytest
+
+from kube_batch_tpu.api import (
+    GPU_RESOURCE_NAME,
+    Resource,
+    build_resource_list,
+    parse_quantity,
+)
+
+
+def res(cpu=0.0, mem=0.0, **scalars):
+    return Resource(milli_cpu=cpu, memory=mem, scalar_resources=scalars or None)
+
+
+class TestParseQuantity:
+    def test_plain(self):
+        assert parse_quantity("4") == 4.0
+        assert parse_quantity(2) == 2.0
+
+    def test_milli(self):
+        assert parse_quantity("1500m") == 1.5
+
+    def test_binary_suffix(self):
+        assert parse_quantity("1Gi") == 2**30
+        assert parse_quantity("10Mi") == 10 * 2**20
+
+    def test_decimal_suffix(self):
+        assert parse_quantity("1G") == 1e9
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+
+
+class TestFromResourceList:
+    def test_cpu_is_milli(self):
+        r = Resource.from_resource_list(build_resource_list(cpu="2", memory="1Gi"))
+        assert r.milli_cpu == 2000.0
+        assert r.memory == 2**30
+
+    def test_scalar_is_milli(self):
+        r = Resource.from_resource_list({GPU_RESOURCE_NAME: "4"})
+        assert r.scalar_resources[GPU_RESOURCE_NAME] == 4000.0
+
+    def test_pods_feed_max_task_num(self):
+        r = Resource.from_resource_list(build_resource_list(pods="110"))
+        assert r.max_task_num == 110
+
+
+class TestArithmetic:
+    def test_add(self):
+        r = res(1000, 100, **{GPU_RESOURCE_NAME: 1000})
+        r.add(res(2000, 50, **{GPU_RESOURCE_NAME: 500}))
+        assert r.milli_cpu == 3000
+        assert r.memory == 150
+        assert r.scalar_resources[GPU_RESOURCE_NAME] == 1500
+
+    def test_add_into_empty(self):
+        r = Resource.empty()
+        r.add(res(100, 10, **{GPU_RESOURCE_NAME: 5}))
+        assert r.scalar_resources[GPU_RESOURCE_NAME] == 5
+
+    def test_sub(self):
+        r = res(3000, 150, **{GPU_RESOURCE_NAME: 1500})
+        r.sub(res(1000, 50, **{GPU_RESOURCE_NAME: 500}))
+        assert r.milli_cpu == 2000
+        assert r.memory == 100
+        assert r.scalar_resources[GPU_RESOURCE_NAME] == 1000
+
+    def test_sub_insufficient_raises(self):
+        with pytest.raises(ValueError):
+            res(100).sub(res(3000))
+
+    def test_sub_within_epsilon_allowed(self):
+        # LessEqual epsilon (resource_info.go:254): |5-0| < 10 so sub passes.
+        r = res(0)
+        r.sub(res(5))
+        assert r.milli_cpu == -5
+
+    def test_multi(self):
+        r = res(1000, 100, **{GPU_RESOURCE_NAME: 10})
+        r.multi(2)
+        assert (r.milli_cpu, r.memory) == (2000, 200)
+        assert r.scalar_resources[GPU_RESOURCE_NAME] == 20
+
+    def test_set_max_resource(self):
+        r = res(1000, 2**30)
+        r.set_max_resource(res(500, 2**31, **{GPU_RESOURCE_NAME: 7}))
+        assert r.milli_cpu == 1000
+        assert r.memory == 2**31
+        assert r.scalar_resources[GPU_RESOURCE_NAME] == 7
+
+    def test_fit_delta_negative_means_insufficient(self):
+        avail = res(1000, 0)
+        avail.fit_delta(res(2000, 0))
+        assert avail.milli_cpu < 0
+        assert avail.memory == 0  # zero-request dims untouched
+
+
+class TestComparisons:
+    def test_less_equal_exact(self):
+        assert res(1000, 100).less_equal(res(1000, 100))
+
+    def test_less_equal_epsilon_cpu(self):
+        # within minMilliCPU=10 counts as <=
+        assert res(1009, 100).less_equal(res(1000, 100))
+        assert not res(1011, 100).less_equal(res(1000, 100))
+
+    def test_less_equal_epsilon_memory(self):
+        five_mib = 5 * 2**20
+        assert res(0, five_mib).less_equal(res(0, 0))
+
+    def test_less_equal_scalar_missing_on_rhs(self):
+        l = res(0, 0, **{GPU_RESOURCE_NAME: 1000})
+        assert not l.less_equal(res(0, 0))
+        assert l.less_equal(res(0, 0, **{GPU_RESOURCE_NAME: 1000}))
+
+    def test_less_strict(self):
+        # Reference quirk (resource_info.go:232-237): when BOTH sides have nil
+        # scalar maps, Less returns false even if cpu/mem are strictly less.
+        assert not res(1, 1).less(res(2, 2))
+        assert res(1, 1).less(res(2, 2, **{GPU_RESOURCE_NAME: 1}))
+        assert not res(2, 1).less(res(2, 2, **{GPU_RESOURCE_NAME: 1}))
+
+    def test_is_empty(self):
+        assert Resource.empty().is_empty()
+        assert res(5, 5 * 2**20).is_empty()
+        assert not res(1000).is_empty()
+        assert not res(0, 0, **{GPU_RESOURCE_NAME: 100}).is_empty()
+
+    def test_is_zero(self):
+        r = res(5, 0, **{GPU_RESOURCE_NAME: 5})
+        assert r.is_zero("cpu")
+        assert r.is_zero("memory")
+        assert r.is_zero(GPU_RESOURCE_NAME)
+        with pytest.raises(KeyError):
+            r.is_zero("unknown/resource")
+
+    def test_diff(self):
+        inc, dec = res(3000, 100).diff(res(1000, 200))
+        assert inc.milli_cpu == 2000
+        assert dec.memory == 100
+
+
+class TestCloneIndependence:
+    def test_clone(self):
+        r = res(1000, 100, **{GPU_RESOURCE_NAME: 5})
+        c = r.clone()
+        c.add(res(1, 1, **{GPU_RESOURCE_NAME: 1}))
+        assert r.milli_cpu == 1000
+        assert r.scalar_resources[GPU_RESOURCE_NAME] == 5
