@@ -4,6 +4,7 @@ from .cluster_info import ClusterInfo
 from .helpers import get_controller_uid, get_task_status, pod_key
 from .job_info import JobID, JobInfo, QueueID, TaskID, TaskInfo, get_job_id
 from .node_info import NodeInfo, NodeState
+from .queue_info import QueueInfo
 from .objects import (
     DEFAULT_SCHEDULER_NAME,
     GROUP_NAME_ANNOTATION_KEY,

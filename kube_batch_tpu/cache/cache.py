@@ -1,0 +1,409 @@
+"""SchedulerCache: the stateful cluster mirror.
+
+Mirrors reference pkg/scheduler/cache/cache.go:
+- One mutex over Jobs/Nodes/Queues/PriorityClasses maps (:73-115).
+- Snapshot() deep-clones ready nodes, queues, and jobs that carry a scheduling
+  spec, resolving job priority from PriorityClasses (:612-659).
+- Bind/Evict mutate the mirror under lock, then fire the side effect
+  asynchronously; failures trigger a rate-limited resync of the task
+  (:421-522, :588-608).
+- Deleted jobs are cleaned up via a queue once terminated (:556-585).
+
+Watch ingest comes from a ClusterAPI watch (the informer analog); tests feed
+the event-handler entry points directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+from ..api import (
+    ClusterInfo,
+    JobInfo,
+    Node,
+    NodeInfo,
+    Pod,
+    PodCondition,
+    PodGroup,
+    PodGroupPhase,
+    PriorityClass,
+    Queue,
+    QueueInfo,
+    TaskInfo,
+    TaskStatus,
+)
+from ..cluster import ADDED, DELETED, MODIFIED, ClusterAPI
+from .event_handlers import EventHandlersMixin
+from .interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
+from .util import job_terminated, shadow_pod_group
+
+
+class DefaultBinder(Binder):
+    """reference cache.go:117-135 (POST /bind analog)"""
+
+    def __init__(self, cluster: ClusterAPI):
+        self.cluster = cluster
+
+    def bind(self, pod: Pod, hostname: str) -> None:
+        self.cluster.bind_pod(pod, hostname)
+
+
+class DefaultEvictor(Evictor):
+    """reference cache.go:137-148 (pod DELETE analog)"""
+
+    def __init__(self, cluster: ClusterAPI):
+        self.cluster = cluster
+
+    def evict(self, pod: Pod) -> None:
+        self.cluster.delete_pod(pod)
+
+
+class DefaultStatusUpdater(StatusUpdater):
+    """reference cache.go:151-197"""
+
+    def __init__(self, cluster: ClusterAPI):
+        self.cluster = cluster
+
+    def update_pod_condition(self, pod: Pod, condition: PodCondition) -> None:
+        self.cluster.update_pod_condition(pod, condition)
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        self.cluster.update_pod_group(pg)
+
+
+class DefaultVolumeBinder(VolumeBinder):
+    """reference cache.go:200-268. tpu-batch has no real PV layer; volumes are
+    modeled as instantly assumable (the seam stays for parity/tests)."""
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        task.volume_ready = True
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        return None
+
+
+class SchedulerCache(Cache, EventHandlersMixin):
+    def __init__(
+        self,
+        cluster: Optional[ClusterAPI] = None,
+        scheduler_name: str = "kube-batch",
+        default_queue: str = "default",
+        binder: Optional[Binder] = None,
+        evictor: Optional[Evictor] = None,
+        status_updater: Optional[StatusUpdater] = None,
+        volume_binder: Optional[VolumeBinder] = None,
+        enable_priority_class: bool = True,
+    ):
+        self.mutex = threading.RLock()
+        self.cluster = cluster
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+        self.enable_priority_class = enable_priority_class
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, PriorityClass] = {}
+        self.default_priority: int = 0
+        self.default_priority_class: Optional[PriorityClass] = None
+
+        self.binder = binder or (DefaultBinder(cluster) if cluster else None)
+        self.evictor = evictor or (DefaultEvictor(cluster) if cluster else None)
+        self.status_updater = status_updater or (
+            DefaultStatusUpdater(cluster) if cluster else None
+        )
+        self.volume_binder = volume_binder or DefaultVolumeBinder()
+
+        # Rate-limited retry queues (reference cache.go:588-608, :556-585).
+        # Items carry a retry count; re-queues back off exponentially.
+        self.err_tasks: "queue.Queue[tuple]" = queue.Queue()
+        self.deleted_jobs: "queue.Queue[tuple]" = queue.Queue()
+        self._base_retry_delay = 0.05
+        self._max_retry_delay = 5.0
+        self._dispatch = self._build_dispatch()
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="cache-sideeffect"
+        )
+        self._synced = cluster is None
+        self._stop = threading.Event()
+
+    # -- watch ingest (informer analog) -------------------------------------
+
+    def _build_dispatch(self):
+        return {
+            ("Pod", ADDED): self.add_pod,
+            ("Pod", MODIFIED): lambda o: self.update_pod(o, o),
+            ("Pod", DELETED): self.delete_pod,
+            ("Node", ADDED): self.add_node,
+            ("Node", MODIFIED): lambda o: self.update_node(o, o),
+            ("Node", DELETED): self.delete_node,
+            ("PodGroup", ADDED): self.add_pod_group,
+            ("PodGroup", MODIFIED): lambda o: self.update_pod_group(o, o),
+            ("PodGroup", DELETED): self.delete_pod_group,
+            ("Queue", ADDED): self.add_queue,
+            ("Queue", MODIFIED): lambda o: self.update_queue(o, o),
+            ("Queue", DELETED): self.delete_queue,
+            ("PriorityClass", ADDED): self.add_priority_class,
+            ("PriorityClass", MODIFIED): lambda o: self.update_priority_class(o, o),
+            ("PriorityClass", DELETED): self.delete_priority_class,
+        }
+
+    def _on_watch_event(self, kind: str, event_type: str, obj) -> None:
+        fn = self._dispatch.get((kind, event_type))
+        if fn is not None:
+            try:
+                fn(obj)
+            except Exception:  # watch handlers must not kill the dispatcher
+                logger.exception(
+                    "failed to handle %s %s event in cache", kind, event_type
+                )
+
+    def run(self, stop_event: Optional[threading.Event] = None) -> None:
+        """Start ingest + resync/cleanup loops (reference cache.go:355-377)."""
+        self._stop = stop_event or threading.Event()
+        if self.cluster is not None:
+            # Watch BEFORE the initial list so objects created during the list
+            # are not lost; duplicate ADDs are tolerated (handlers key by uid).
+            self.cluster.add_watch(self._on_watch_event)
+            for kind in ("Node", "Queue", "PriorityClass", "PodGroup", "Pod"):
+                for obj in self.cluster.list_objects(kind):
+                    self._on_watch_event(kind, ADDED, obj)
+            self._synced = True
+        threading.Thread(
+            target=self._process_resync_loop, daemon=True, name="cache-resync"
+        ).start()
+        threading.Thread(
+            target=self._process_cleanup_loop, daemon=True, name="cache-cleanup"
+        ).start()
+
+    def wait_for_cache_sync(self, stop_event=None, timeout: float = 10.0) -> bool:
+        deadline = time.time() + timeout
+        while not self._synced and time.time() < deadline:
+            time.sleep(0.01)
+        return self._synced
+
+    # -- retry loops --------------------------------------------------------
+
+    def _retry_delay(self, attempt: int) -> float:
+        return min(self._base_retry_delay * (2**attempt), self._max_retry_delay)
+
+    def _resync_task(self, task: TaskInfo, attempt: int = 0) -> None:
+        """reference cache.go:588-595 (AddRateLimited analog)"""
+        self.err_tasks.put((task, attempt))
+
+    def _queue_job_cleanup(self, job: JobInfo, attempt: int = 0) -> None:
+        self.deleted_jobs.put((job, attempt))
+
+    def _process_resync_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                task, attempt = self.err_tasks.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._sync_task(task)
+            except Exception:
+                logger.exception("failed to resync task %s/%s", task.namespace, task.name)
+                self._stop.wait(self._retry_delay(attempt))
+                self._resync_task(task, attempt + 1)
+
+    def _process_cleanup_loop(self) -> None:
+        """reference cache.go:556-585 (waits for JobTerminated)"""
+        while not self._stop.is_set():
+            try:
+                job, attempt = self.deleted_jobs.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            with self.mutex:
+                terminated = job_terminated(job)
+                if terminated:
+                    self.jobs.pop(job.uid, None)
+            if not terminated:
+                self._stop.wait(self._retry_delay(attempt))
+                self._queue_job_cleanup(job, attempt + 1)
+
+    # -- snapshot (reference cache.go:612-659) --------------------------------
+
+    def snapshot(self) -> ClusterInfo:
+        with self.mutex:
+            snap = ClusterInfo()
+            for name, node in self.nodes.items():
+                if not node.ready():
+                    continue
+                snap.nodes[name] = node.clone()
+            for name, q in self.queues.items():
+                snap.queues[name] = q.clone()
+            for key, job in self.jobs.items():
+                # Jobs without a scheduling spec are not schedulable.
+                if job.pod_group is None:
+                    continue
+                if self.enable_priority_class and job.pod_group is not None:
+                    job.priority = self.default_priority
+                    pc = self.priority_classes.get(
+                        job.pod_group.spec.priority_class_name
+                    )
+                    if pc is not None:
+                        job.priority = pc.value
+                snap.jobs[key] = job.clone()
+            return snap
+
+    # -- side effects --------------------------------------------------------
+
+    def _find_job_and_task(self, ti: TaskInfo):
+        """reference cache.go:397-419"""
+        job = self.jobs.get(ti.job)
+        if job is None:
+            raise KeyError(f"failed to find job <{ti.job}>")
+        task = job.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(f"failed to find task <{ti.namespace}/{ti.name}>")
+        return job, task
+
+    def bind(self, task_info: TaskInfo, hostname: str) -> None:
+        """reference cache.go:480-522"""
+        with self.mutex:
+            job, task = self._find_job_and_task(task_info)
+            node = self.nodes.get(hostname)
+            if node is None:
+                raise KeyError(
+                    f"failed to bind Task {task.uid} to host {hostname}: "
+                    f"host does not exist"
+                )
+            if task.status not in (TaskStatus.PENDING, TaskStatus.ALLOCATED):
+                raise ValueError(
+                    f"failed to bind Task {task.uid}: status is "
+                    f"{task.status.name}, expected Pending/Allocated"
+                )
+            job.update_task_status(task, TaskStatus.BINDING)
+            task.node_name = hostname
+            node.add_task(task)
+            pod = task.pod
+            task_snapshot = task.clone()
+
+        def _do_bind():
+            try:
+                self.binder.bind(pod, hostname)
+                if self.cluster is not None:
+                    self.cluster.record_event(
+                        pod, "Normal", "Scheduled",
+                        f"Successfully assigned {pod.namespace}/{pod.name} to {hostname}",
+                    )
+            except Exception:
+                self._resync_task(task_snapshot)
+
+        if self.binder is not None:
+            self._executor.submit(_do_bind)
+
+    def evict(self, task_info: TaskInfo, reason: str) -> None:
+        """reference cache.go:421-477"""
+        with self.mutex:
+            job, task = self._find_job_and_task(task_info)
+            node = self.nodes.get(task.node_name)
+            if node is None:
+                raise KeyError(
+                    f"failed to evict Task {task.uid}: host {task.node_name} "
+                    f"does not exist"
+                )
+            job.update_task_status(task, TaskStatus.RELEASING)
+            node.update_task(task)
+            pod = task.pod
+            task_snapshot = task.clone()
+            if not shadow_pod_group(job.pod_group) and self.cluster is not None:
+                self.cluster.record_event(
+                    job.pod_group, "Normal", "Evict", reason
+                )
+
+        def _do_evict():
+            try:
+                self.evictor.evict(pod)
+            except Exception:
+                self._resync_task(task_snapshot)
+
+        if self.evictor is not None:
+            self._executor.submit(_do_evict)
+
+    # -- volumes -------------------------------------------------------------
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        self.volume_binder.bind_volumes(task)
+
+    # -- status / events -----------------------------------------------------
+
+    def task_unschedulable(self, task: TaskInfo, message: str) -> None:
+        """FailedScheduling event + PodScheduled=False condition
+        (reference cache.go:533-554)."""
+        pod = task.pod
+        condition = PodCondition(
+            type="PodScheduled", status="False",
+            reason="Unschedulable", message=message,
+        )
+        if self.cluster is not None:
+            self.cluster.record_event(pod, "Warning", "FailedScheduling", message)
+        if self.status_updater is not None:
+            self.status_updater.update_pod_condition(pod, condition)
+
+    def record_job_status_event(self, job: JobInfo) -> None:
+        """reference cache.go:695-746"""
+        base_message = (
+            f"{len(job.task_status_index.get(TaskStatus.PENDING, {}))} pods "
+            f"are yet to be scheduled"
+        )
+        if not job.ready():
+            if self.cluster is not None and not shadow_pod_group(job.pod_group):
+                self.cluster.record_event(
+                    job.pod_group, "Warning", "Unschedulable",
+                    f"{job.namespace}/{job.name}: {base_message}",
+                )
+        # reference cache.go:736-744 iterates [Allocated, Pending].
+        job_err_msg = job.fit_error()
+        for status in (TaskStatus.ALLOCATED, TaskStatus.PENDING):
+            for task in job.task_status_index.get(status, {}).values():
+                self.task_unschedulable(task, job_err_msg)
+
+    def update_job_status(self, job: JobInfo) -> JobInfo:
+        """Persist PodGroup status (reference cache.go:749-764)."""
+        if not shadow_pod_group(job.pod_group):
+            pg = job.pod_group
+            pg.status.running = len(job.task_status_index.get(TaskStatus.RUNNING, {}))
+            pg.status.succeeded = len(
+                job.task_status_index.get(TaskStatus.SUCCEEDED, {})
+            )
+            pg.status.failed = len(job.task_status_index.get(TaskStatus.FAILED, {}))
+            if self.status_updater is not None:
+                self.status_updater.update_pod_group(pg)
+        return job
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._executor.shutdown(wait=True)
+
+    # String (reference cache.go String()) omitted; repr is enough.
+    def __repr__(self) -> str:
+        return (
+            f"SchedulerCache(jobs={len(self.jobs)}, nodes={len(self.nodes)}, "
+            f"queues={len(self.queues)})"
+        )
+
+
+def new_scheduler_cache(cluster: ClusterAPI, scheduler_name: str, default_queue: str,
+                        **kwargs) -> SchedulerCache:
+    """reference cache.go:68 New / :223 newSchedulerCache"""
+    return SchedulerCache(
+        cluster=cluster,
+        scheduler_name=scheduler_name,
+        default_queue=default_queue,
+        **kwargs,
+    )

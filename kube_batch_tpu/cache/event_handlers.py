@@ -1,0 +1,279 @@
+"""Cache event handlers: cluster watch events → domain-model mutations.
+
+Mirrors reference pkg/scheduler/cache/event_handlers.go. These are the entry
+points the watch dispatcher calls, and the same entry points the tests feed
+synthetic objects through (the reference test pattern,
+actions/allocate/allocate_test.go:164-176).
+
+All handlers take the cache mutex; they mutate Jobs/Nodes/Queues maps only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import (
+    JobInfo,
+    Node,
+    NodeInfo,
+    Pod,
+    PodGroup,
+    PriorityClass,
+    Queue,
+    QueueInfo,
+    TaskInfo,
+    TaskStatus,
+)
+from .util import create_shadow_pod_group, job_terminated, shadow_pod_group
+
+
+def _is_terminated(status: TaskStatus) -> bool:
+    return status in (TaskStatus.SUCCEEDED, TaskStatus.FAILED)
+
+
+class EventHandlersMixin:
+    """Handler methods mixed into SchedulerCache."""
+
+    # ---- pods (reference event_handlers.go:45-262) -------------------------
+
+    def _get_or_create_job(self, ti: TaskInfo) -> Optional[JobInfo]:
+        """reference event_handlers.go:44-70; pods of other schedulers with no
+        group get no job; group-less pods of ours get a shadow PodGroup whose
+        name (controller/pod UID) is the job key, queued on the default queue
+        (event_handlers.go:52-59)."""
+        if not ti.job:
+            if ti.pod.spec.scheduler_name != self.scheduler_name:
+                return None
+            pg = create_shadow_pod_group(ti.pod)
+            ti.job = pg.name
+            if ti.job not in self.jobs:
+                job = JobInfo(ti.job)
+                job.set_pod_group(pg)
+                job.queue = self.default_queue
+                self.jobs[ti.job] = job
+        elif ti.job not in self.jobs:
+            self.jobs[ti.job] = JobInfo(ti.job)
+        return self.jobs[ti.job]
+
+    def _effective_job_key(self, ti: TaskInfo) -> str:
+        """The job key a pod WOULD be filed under, without creating anything.
+        Divergence from the reference: updatePod/deletePod there rebuild the
+        task from the pod and get Job=="" for shadow-group pods, so the shadow
+        job's accounting is never cleaned up (event_handlers.go:128-180) —
+        a double-count bug we do not reproduce."""
+        if ti.job:
+            return ti.job
+        from ..api import get_controller_uid
+
+        return get_controller_uid(ti.pod) or ti.pod.uid
+
+    def _add_task(self, ti: TaskInfo) -> None:
+        """reference event_handlers.go:60-90"""
+        job = self._get_or_create_job(ti)
+        if job is not None:
+            job.add_task_info(ti)
+        if ti.node_name:
+            if ti.node_name not in self.nodes:
+                self.nodes[ti.node_name] = NodeInfo(None)
+            if not _is_terminated(ti.status):
+                node = self.nodes[ti.node_name]
+                from ..api import pod_key
+
+                if pod_key(ti.pod) in node.tasks:
+                    # Self-healing on reconcile: replace the stale entry
+                    # instead of wedging the resync loop on a duplicate-add.
+                    node.update_task(ti)
+                else:
+                    node.add_task(ti)
+
+    def _delete_task(self, ti: TaskInfo) -> None:
+        """reference event_handlers.go deleteTask"""
+        job_err = node_err = None
+        if ti.job:
+            job = self.jobs.get(ti.job)
+            if job is not None:
+                try:
+                    job.delete_task_info(ti)
+                except KeyError as e:
+                    job_err = e
+            else:
+                job_err = KeyError(f"job {ti.job} not found")
+        if ti.node_name:
+            node = self.nodes.get(ti.node_name)
+            if node is not None:
+                try:
+                    node.remove_task(ti)
+                except KeyError as e:
+                    node_err = e
+        if job_err or node_err:
+            raise KeyError(f"failed to delete task {ti.namespace}/{ti.name}: "
+                           f"{job_err or ''} {node_err or ''}")
+
+    def _update_task(self, old: TaskInfo, new: TaskInfo) -> None:
+        """Delete + re-add (reference event_handlers.go:119-129)."""
+        self._delete_task(old)
+        self._add_pod_locked(new.pod)
+
+    def _sync_task(self, old: TaskInfo) -> None:
+        """Reconcile one task against cluster truth after a failed side effect
+        (reference event_handlers.go:99-117)."""
+        with self.mutex:
+            pod = self.cluster.get_pod(old.namespace, old.name) if self.cluster else None
+            if pod is None:
+                try:
+                    self._delete_task(old)
+                except KeyError:
+                    pass
+                return
+            self._update_task(old, TaskInfo(pod))
+
+    def _accept_pod(self, pod: Pod) -> bool:
+        """Informer filter analog (reference cache.go:305-316): pending pods of
+        this scheduler + all non-pending pods (they hold resources)."""
+        from ..api import PodPhase
+
+        if pod.spec.scheduler_name == self.scheduler_name and (
+            pod.status.phase == PodPhase.PENDING
+        ):
+            return True
+        return pod.status.phase != PodPhase.PENDING
+
+    def _add_pod_locked(self, pod: Pod) -> None:
+        ti = TaskInfo(pod)
+        # Idempotent: list-after-watch can replay ADDs (cache.py run()).
+        job = self.jobs.get(self._effective_job_key(ti))
+        if job is not None and ti.uid in job.tasks:
+            return
+        self._add_task(ti)
+
+    def add_pod(self, pod: Pod) -> None:
+        """reference event_handlers.go:185-201"""
+        if not self._accept_pod(pod):
+            return
+        with self.mutex:
+            self._add_pod_locked(pod)
+
+    def _stored_task(self, ti: TaskInfo) -> TaskInfo:
+        """Resolve to the cache's own TaskInfo (handles Binding status drift,
+        reference event_handlers.go:162-170)."""
+        job = self.jobs.get(self._effective_job_key(ti))
+        if job is not None and ti.uid in job.tasks:
+            return job.tasks[ti.uid]
+        return ti
+
+    def update_pod(self, old_pod: Pod, new_pod: Pod) -> None:
+        """reference event_handlers.go:128-133 (deletePod + addPod)"""
+        if not self._accept_pod(new_pod):
+            return
+        with self.mutex:
+            old_ti = self._stored_task(TaskInfo(old_pod))
+            try:
+                self._delete_task(old_ti)
+            except KeyError:
+                pass
+            self._add_pod_locked(new_pod)
+
+    def delete_pod(self, pod: Pod) -> None:
+        """reference event_handlers.go:162-180"""
+        with self.mutex:
+            ti = TaskInfo(pod)
+            task = self._stored_task(ti)
+            job = self.jobs.get(self._effective_job_key(ti))
+            try:
+                self._delete_task(task)
+            except KeyError:
+                pass
+            if job is not None and job_terminated(job):
+                self._queue_job_cleanup(job)
+
+    # ---- nodes (reference event_handlers.go:264-366) -----------------------
+
+    def add_node(self, node: Node) -> None:
+        with self.mutex:
+            if node.name in self.nodes:
+                self.nodes[node.name].set_node(node)
+            else:
+                self.nodes[node.name] = NodeInfo(node)
+
+    def update_node(self, old_node: Node, new_node: Node) -> None:
+        with self.mutex:
+            if new_node.name in self.nodes:
+                self.nodes[new_node.name].set_node(new_node)
+            else:
+                self.nodes[new_node.name] = NodeInfo(new_node)
+
+    def delete_node(self, node: Node) -> None:
+        with self.mutex:
+            self.nodes.pop(node.name, None)
+
+    # ---- pod groups (reference event_handlers.go:370-659) ------------------
+
+    def _job_key(self, pg: PodGroup) -> str:
+        return f"{pg.namespace}/{pg.name}"
+
+    def _set_pod_group(self, pg: PodGroup) -> None:
+        """reference event_handlers.go:370-389 (incl. default-queue fallback)"""
+        key = self._job_key(pg)
+        if key not in self.jobs:
+            self.jobs[key] = JobInfo(key)
+        self.jobs[key].set_pod_group(pg)
+        if not pg.spec.queue:
+            self.jobs[key].queue = self.default_queue
+
+    def add_pod_group(self, pg: PodGroup) -> None:
+        with self.mutex:
+            self._set_pod_group(pg)
+
+    def update_pod_group(self, old_pg: PodGroup, new_pg: PodGroup) -> None:
+        with self.mutex:
+            self._set_pod_group(new_pg)
+
+    def delete_pod_group(self, pg: PodGroup) -> None:
+        with self.mutex:
+            key = self._job_key(pg)
+            job = self.jobs.get(key)
+            if job is not None:
+                job.unset_pod_group()
+                if job_terminated(job):
+                    self._queue_job_cleanup(job)
+
+    # ---- queues (reference event_handlers.go:775-1036) ---------------------
+
+    def add_queue(self, queue: Queue) -> None:
+        with self.mutex:
+            self.queues[queue.name] = QueueInfo(queue)
+
+    def update_queue(self, old_queue: Queue, new_queue: Queue) -> None:
+        with self.mutex:
+            self.queues[new_queue.name] = QueueInfo(new_queue)
+
+    def delete_queue(self, queue: Queue) -> None:
+        with self.mutex:
+            self.queues.pop(queue.name, None)
+
+    # ---- priority classes (reference event_handlers.go:1038-1129) ----------
+
+    def add_priority_class(self, pc: PriorityClass) -> None:
+        with self.mutex:
+            self._add_priority_class_locked(pc)
+
+    def update_priority_class(self, old_pc: PriorityClass, new_pc: PriorityClass) -> None:
+        with self.mutex:
+            self._delete_priority_class_locked(old_pc)
+            self._add_priority_class_locked(new_pc)
+
+    def delete_priority_class(self, pc: PriorityClass) -> None:
+        with self.mutex:
+            self._delete_priority_class_locked(pc)
+
+    def _add_priority_class_locked(self, pc: PriorityClass) -> None:
+        if pc.global_default:
+            self.default_priority_class = pc
+            self.default_priority = pc.value
+        self.priority_classes[pc.name] = pc
+
+    def _delete_priority_class_locked(self, pc: PriorityClass) -> None:
+        if pc.global_default:
+            self.default_priority_class = None
+            self.default_priority = 0
+        self.priority_classes.pop(pc.name, None)

@@ -1,0 +1,81 @@
+"""Cache interface + the four side-effect seams.
+
+Mirrors reference pkg/scheduler/cache/interface.go:
+- Cache (:26-55): Run, Snapshot, WaitForCacheSync, Bind, Evict,
+  RecordJobStatusEvent, UpdateJobStatus, AllocateVolumes, BindVolumes.
+- Binder/Evictor/StatusUpdater/VolumeBinder (:57-77) — the seams behind which
+  all cluster I/O hides, making the decision core testable with zero cluster.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ..api import ClusterInfo, JobInfo, Pod, PodCondition, PodGroup, TaskInfo
+
+
+class Binder(ABC):
+    """reference interface.go:57-60"""
+
+    @abstractmethod
+    def bind(self, pod: "Pod", hostname: str) -> None: ...
+
+
+class Evictor(ABC):
+    """reference interface.go:62-65"""
+
+    @abstractmethod
+    def evict(self, pod: "Pod") -> None: ...
+
+
+class StatusUpdater(ABC):
+    """reference interface.go:67-71"""
+
+    @abstractmethod
+    def update_pod_condition(self, pod: "Pod", condition: "PodCondition") -> None: ...
+
+    @abstractmethod
+    def update_pod_group(self, pg: "PodGroup") -> None: ...
+
+
+class VolumeBinder(ABC):
+    """reference interface.go:73-77"""
+
+    @abstractmethod
+    def allocate_volumes(self, task: "TaskInfo", hostname: str) -> None: ...
+
+    @abstractmethod
+    def bind_volumes(self, task: "TaskInfo") -> None: ...
+
+
+class Cache(ABC):
+    """reference interface.go:26-55"""
+
+    @abstractmethod
+    def run(self, stop_event) -> None: ...
+
+    @abstractmethod
+    def wait_for_cache_sync(self, stop_event) -> bool: ...
+
+    @abstractmethod
+    def snapshot(self) -> "ClusterInfo": ...
+
+    @abstractmethod
+    def bind(self, task: "TaskInfo", hostname: str) -> None: ...
+
+    @abstractmethod
+    def evict(self, task: "TaskInfo", reason: str) -> None: ...
+
+    @abstractmethod
+    def record_job_status_event(self, job: "JobInfo") -> None: ...
+
+    @abstractmethod
+    def update_job_status(self, job: "JobInfo") -> "JobInfo": ...
+
+    @abstractmethod
+    def allocate_volumes(self, task: "TaskInfo", hostname: str) -> None: ...
+
+    @abstractmethod
+    def bind_volumes(self, task: "TaskInfo") -> None: ...

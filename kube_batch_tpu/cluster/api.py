@@ -1,0 +1,184 @@
+"""The cluster substrate: an in-process API-server analog.
+
+The reference's distributed "communication backend" is the Kubernetes API
+server — informer watches in, REST writes out (SURVEY.md §2). tpu-batch is
+standalone, so this module provides the same contract as a small event-sourced
+object store:
+
+- ``ClusterAPI``: list/watch objects, bind/delete pods, update statuses.
+- ``InProcessCluster``: thread-safe implementation with watch fan-out and an
+  optional kubelet simulation (bound pods transition to Running), which is the
+  kubemark-analog used by e2e-style tests and the benchmark harness.
+
+A real deployment would put a gRPC or k8s adapter behind the same interface;
+the scheduler cache only ever sees ``ClusterAPI``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..api import (
+    Node,
+    Pod,
+    PodCondition,
+    PodGroup,
+    PodPhase,
+    PriorityClass,
+    Queue,
+)
+
+# Watch event types.
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+WatchHandler = Callable[[str, str, object], None]  # (kind, event_type, obj)
+
+
+class ClusterAPI:
+    """Contract between the scheduler cache and the cluster substrate."""
+
+    # -- reads / watches ----------------------------------------------------
+
+    def list_objects(self, kind: str) -> List[object]:
+        raise NotImplementedError
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        raise NotImplementedError
+
+    def add_watch(self, handler: WatchHandler) -> None:
+        raise NotImplementedError
+
+    # -- writes (the scheduler's side effects) ------------------------------
+
+    def bind_pod(self, pod: Pod, hostname: str) -> None:
+        raise NotImplementedError
+
+    def delete_pod(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def update_pod_condition(self, pod: Pod, condition: PodCondition) -> None:
+        raise NotImplementedError
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        raise NotImplementedError
+
+    def record_event(self, obj: object, event_type: str, reason: str, message: str) -> None:
+        raise NotImplementedError
+
+
+class InProcessCluster(ClusterAPI):
+    """Thread-safe in-memory cluster with watch fan-out.
+
+    ``simulate_kubelet=True`` makes binds eventually set the pod Running
+    (the hollow-node/kubemark analog, reference test/kubemark/)."""
+
+    KINDS = ("Pod", "Node", "PodGroup", "Queue", "PriorityClass")
+
+    def __init__(self, simulate_kubelet: bool = True):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[str, object]] = {k: {} for k in self.KINDS}
+        self._watchers: List[WatchHandler] = []
+        self.simulate_kubelet = simulate_kubelet
+        self.events: List[tuple] = []  # recorded cluster events (observability)
+
+    # -- internal -----------------------------------------------------------
+
+    @staticmethod
+    def _key(obj) -> str:
+        meta = obj.metadata
+        return f"{meta.namespace}/{meta.name}" if meta.namespace else meta.name
+
+    def _notify(self, kind: str, event_type: str, obj) -> None:
+        for handler in list(self._watchers):
+            handler(kind, event_type, obj)
+
+    # -- generic object store -----------------------------------------------
+
+    def create(self, kind: str, obj) -> None:
+        with self._lock:
+            self._objects[kind][self._key(obj)] = obj
+        self._notify(kind, ADDED, obj)
+
+    def update(self, kind: str, obj) -> None:
+        with self._lock:
+            self._objects[kind][self._key(obj)] = obj
+        self._notify(kind, MODIFIED, obj)
+
+    def delete(self, kind: str, obj) -> None:
+        with self._lock:
+            self._objects[kind].pop(self._key(obj), None)
+        self._notify(kind, DELETED, obj)
+
+    def list_objects(self, kind: str) -> List[object]:
+        with self._lock:
+            return list(self._objects[kind].values())
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        with self._lock:
+            return self._objects["Pod"].get(f"{namespace}/{name}")
+
+    def add_watch(self, handler: WatchHandler) -> None:
+        with self._lock:
+            self._watchers.append(handler)
+
+    # -- typed conveniences ---------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> None:
+        self.create("Pod", pod)
+
+    def create_node(self, node: Node) -> None:
+        self.create("Node", node)
+
+    def create_pod_group(self, pg: PodGroup) -> None:
+        self.create("PodGroup", pg)
+
+    def create_queue(self, q: Queue) -> None:
+        self.create("Queue", q)
+
+    def create_priority_class(self, pc: PriorityClass) -> None:
+        self.create("PriorityClass", pc)
+
+    # -- scheduler side effects ---------------------------------------------
+
+    def bind_pod(self, pod: Pod, hostname: str) -> None:
+        """Analog of POST pods/<name>/binding (reference cache.go:121-135)."""
+        with self._lock:
+            stored = self._objects["Pod"].get(self._key(pod))
+            if stored is None:
+                raise KeyError(f"pod {self._key(pod)} not found")
+            if stored.spec.node_name and stored.spec.node_name != hostname:
+                raise ValueError(
+                    f"pod {self._key(pod)} already bound to {stored.spec.node_name}"
+                )
+            stored.spec.node_name = hostname
+            if self.simulate_kubelet:
+                stored.status.phase = PodPhase.RUNNING
+        self._notify("Pod", MODIFIED, stored)
+
+    def delete_pod(self, pod: Pod) -> None:
+        """Analog of pod DELETE for eviction (reference cache.go:137-148)."""
+        self.delete("Pod", pod)
+
+    def update_pod_condition(self, pod: Pod, condition: PodCondition) -> None:
+        with self._lock:
+            stored = self._objects["Pod"].get(self._key(pod))
+            if stored is None:
+                return
+            for i, c in enumerate(stored.status.conditions):
+                if c.type == condition.type:
+                    stored.status.conditions[i] = condition
+                    break
+            else:
+                stored.status.conditions.append(condition)
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        with self._lock:
+            self._objects["PodGroup"][self._key(pg)] = pg
+        self._notify("PodGroup", MODIFIED, pg)
+
+    def record_event(self, obj, event_type: str, reason: str, message: str) -> None:
+        with self._lock:
+            self.events.append((type(obj).__name__, self._key(obj), event_type, reason, message))

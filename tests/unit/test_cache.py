@@ -1,0 +1,214 @@
+"""SchedulerCache tests (port of reference cache/cache_test.go:128-309)."""
+
+import time
+
+import pytest
+
+from kube_batch_tpu.api import (
+    ObjectMeta,
+    PodPhase,
+    PriorityClass,
+    TaskStatus,
+    build_resource_list,
+)
+from kube_batch_tpu.cache import SchedulerCache, shadow_pod_group
+from kube_batch_tpu.utils.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+
+def make_cache(**kwargs):
+    return SchedulerCache(
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+        **kwargs,
+    )
+
+
+def req(cpu="1", mem="1Gi"):
+    return build_resource_list(cpu=cpu, memory=mem)
+
+
+class TestIngest:
+    def test_add_pod_creates_shadow_job(self):
+        # reference cache_test.go TestAddPod: pods without a group get a
+        # shadow PodGroup keyed by owner/pod UID on the default queue.
+        c = make_cache()
+        owner = "owner-1"
+        p1 = build_pod("c1", "p1", "", PodPhase.PENDING, req(), owner_uid=owner)
+        p2 = build_pod("c1", "p2", "n1", PodPhase.RUNNING, req(), owner_uid=owner)
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="2Gi")))
+        c.add_pod(p1)
+        c.add_pod(p2)
+        assert owner in c.jobs
+        job = c.jobs[owner]
+        assert len(job.tasks) == 2
+        assert shadow_pod_group(job.pod_group)
+        assert job.queue == "default"
+        assert c.nodes["n1"].used.milli_cpu == 1000
+
+    def test_add_node_with_existing_bound_pods(self):
+        # reference cache_test.go TestAddNode: bound pod arrives before node
+        c = make_cache()
+        p = build_pod("c1", "p1", "n1", PodPhase.RUNNING, req())
+        c.add_pod(p)
+        # node exists as placeholder, not ready
+        assert not c.nodes["n1"].ready()
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="2Gi")))
+        ni = c.nodes["n1"]
+        assert ni.ready()
+        assert ni.idle.milli_cpu == 1000
+        assert ni.used.milli_cpu == 1000
+
+    def test_pod_group_attaches_to_job(self):
+        c = make_cache()
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=3))
+        c.add_pod(
+            build_pod("ns", "p1", "", PodPhase.PENDING, req(), group_name="pg1")
+        )
+        job = c.jobs["ns/pg1"]
+        assert job.min_available == 3
+        assert len(job.tasks) == 1
+        assert not shadow_pod_group(job.pod_group)
+
+    def test_pod_group_empty_queue_gets_default(self):
+        c = make_cache()
+        pg = build_pod_group("pg1", namespace="ns", queue="")
+        c.add_pod_group(pg)
+        assert c.jobs["ns/pg1"].queue == "default"
+
+    def test_other_scheduler_pending_pod_ignored(self):
+        c = make_cache()
+        p = build_pod("c1", "p1", "", PodPhase.PENDING, req())
+        p.spec.scheduler_name = "default-scheduler"
+        c.add_pod(p)
+        assert not c.jobs
+
+    def test_other_scheduler_running_pod_occupies_node(self):
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="2Gi")))
+        p = build_pod("c1", "p1", "n1", PodPhase.RUNNING, req())
+        p.spec.scheduler_name = "default-scheduler"
+        c.add_pod(p)
+        assert not c.jobs  # no job tracked...
+        assert c.nodes["n1"].used.milli_cpu == 1000  # ...but resources held
+
+    def test_update_pod_rebinds_accounting(self):
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list(cpu="4", memory="4Gi")))
+        old = build_pod("ns", "p1", "", PodPhase.PENDING, req(), group_name="pg1")
+        c.add_pod_group(build_pod_group("pg1", namespace="ns"))
+        c.add_pod(old)
+        new = build_pod("ns", "p1", "n1", PodPhase.RUNNING, req(), group_name="pg1")
+        new.metadata.uid = old.metadata.uid
+        c.update_pod(old, new)
+        job = c.jobs["ns/pg1"]
+        assert len(job.tasks) == 1
+        assert job.tasks[old.metadata.uid].status == TaskStatus.RUNNING
+        assert job.total_request.milli_cpu == 1000  # no double count
+        assert c.nodes["n1"].used.milli_cpu == 1000
+
+    def test_delete_pod(self):
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list(cpu="4", memory="4Gi")))
+        p = build_pod("ns", "p1", "n1", PodPhase.RUNNING, req(), group_name="pg1")
+        c.add_pod_group(build_pod_group("pg1", namespace="ns"))
+        c.add_pod(p)
+        c.delete_pod(p)
+        assert not c.jobs["ns/pg1"].tasks
+        assert c.nodes["n1"].used.milli_cpu == 0
+
+    def test_queue_ingest(self):
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=4))
+        assert c.queues["q1"].weight == 4
+        c.delete_queue(build_queue("q1"))
+        assert "q1" not in c.queues
+
+
+class TestSnapshot:
+    def test_snapshot_is_deep_clone(self):
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list(cpu="4", memory="4Gi")))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns"))
+        c.add_pod(build_pod("ns", "p1", "", PodPhase.PENDING, req(), group_name="pg1"))
+        snap = c.snapshot()
+        task = next(iter(snap.jobs["ns/pg1"].tasks.values()))
+        snap.jobs["ns/pg1"].update_task_status(task, TaskStatus.ALLOCATED)
+        snap.nodes["n1"].idle.sub(task.resreq)
+        # cache unchanged
+        cache_task = c.jobs["ns/pg1"].tasks[task.uid]
+        assert cache_task.status == TaskStatus.PENDING
+        assert c.nodes["n1"].idle.milli_cpu == 4000
+
+    def test_snapshot_skips_not_ready_nodes_and_specless_jobs(self):
+        c = make_cache()
+        c.add_pod(build_pod("ns", "p1", "ghost", PodPhase.RUNNING, req(), group_name="pg"))
+        snap = c.snapshot()
+        assert "ghost" not in snap.nodes  # placeholder node is NotReady
+        assert "ns/pg" not in snap.jobs  # no PodGroup → no scheduling spec
+
+    def test_snapshot_resolves_priority_class(self):
+        c = make_cache()
+        c.add_priority_class(
+            PriorityClass(metadata=ObjectMeta(name="high", namespace=""), value=100)
+        )
+        c.add_priority_class(
+            PriorityClass(
+                metadata=ObjectMeta(name="low", namespace=""),
+                value=5,
+                global_default=True,
+            )
+        )
+        c.add_pod_group(
+            build_pod_group("pg1", namespace="ns", priority_class_name="high")
+        )
+        c.add_pod_group(build_pod_group("pg2", namespace="ns"))
+        snap = c.snapshot()
+        assert snap.jobs["ns/pg1"].priority == 100
+        assert snap.jobs["ns/pg2"].priority == 5  # global default
+
+
+class TestSideEffects:
+    def setup_bound_job(self, c):
+        c.add_node(build_node("n1", build_resource_list(cpu="4", memory="4Gi")))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns"))
+        p = build_pod("ns", "p1", "", PodPhase.PENDING, req(), group_name="pg1")
+        c.add_pod(p)
+        return c.jobs["ns/pg1"].tasks[p.metadata.uid]
+
+    def test_bind(self):
+        c = make_cache()
+        task = self.setup_bound_job(c)
+        c.bind(task, "n1")
+        assert task.status == TaskStatus.BINDING
+        assert task.node_name == "n1"
+        assert c.nodes["n1"].used.milli_cpu == 1000
+        # async binder fired
+        key = c.binder.channel.get(timeout=3)
+        assert c.binder.binds[key] == "n1"
+
+    def test_bind_missing_host_raises(self):
+        c = make_cache()
+        task = self.setup_bound_job(c)
+        with pytest.raises(KeyError):
+            c.bind(task, "nope")
+
+    def test_evict(self):
+        c = make_cache()
+        task = self.setup_bound_job(c)
+        c.bind(task, "n1")
+        c.evict(task, "preempted")
+        assert task.status == TaskStatus.RELEASING
+        assert c.nodes["n1"].releasing.milli_cpu == 1000
+        key = c.evictor.channel.get(timeout=3)
+        assert key == "ns/p1"
