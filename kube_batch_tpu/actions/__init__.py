@@ -1,0 +1,7 @@
+"""Actions (mirrors reference pkg/scheduler/actions).
+
+Importing this package registers every builtin action with the framework
+registry (the reference's factory.go:28-33 / init() pattern). The TPU-native
+allocate_tpu action is registered lazily by kube_batch_tpu.ops import."""
+
+from . import allocate, backfill, preempt, reclaim  # noqa: F401

@@ -1,0 +1,42 @@
+"""Backfill action (reference actions/backfill/backfill.go:40-73): every
+pending BestEffort task (empty resource request) goes to the first node that
+passes predicates."""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import TaskStatus
+from ..framework import Action, register_action
+from ..utils.scheduler_helper import get_node_list
+
+logger = logging.getLogger(__name__)
+
+
+class BackfillAction(Action):
+    def name(self) -> str:
+        return "backfill"
+
+    def execute(self, ssn) -> None:
+        for job in ssn.jobs.values():
+            for task in list(
+                job.task_status_index.get(TaskStatus.PENDING, {}).values()
+            ):
+                if not task.init_resreq.is_empty():
+                    continue  # TODO parity: reference only backfills BestEffort
+                for node in get_node_list(ssn.nodes):
+                    try:
+                        ssn.predicate_fn(task, node)
+                    except Exception:
+                        continue
+                    try:
+                        ssn.allocate(task, node.name)
+                    except Exception:
+                        logger.exception(
+                            "Failed to bind Task %s on %s", task.uid, node.name
+                        )
+                        continue
+                    break
+
+
+register_action(BackfillAction())

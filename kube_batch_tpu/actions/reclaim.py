@@ -1,0 +1,122 @@
+"""Reclaim action: cross-queue eviction for starving queues.
+
+Mirrors reference actions/reclaim/reclaim.go:41-196: for each non-overused
+queue, pop starving job/task by order fns; per node, collect RUNNING tasks of
+OTHER queues → ssn.reclaimable victims → ssn.evict("reclaim") until the
+request is covered → ssn.pipeline the claimant. Direct evictions, no
+Statement (no rollback).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import Resource, TaskStatus
+from ..framework import Action, register_action
+from ..utils import PriorityQueue
+from ..utils.scheduler_helper import get_node_list
+
+logger = logging.getLogger(__name__)
+
+
+class ReclaimAction(Action):
+    def name(self) -> str:
+        return "reclaim"
+
+    def execute(self, ssn) -> None:
+        queues = PriorityQueue(ssn.queue_order_fn)
+        queue_map = {}
+        preemptors_map = {}
+        preemptor_tasks = {}
+
+        for job in ssn.jobs.values():
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                logger.error(
+                    "Failed to find Queue <%s> for Job <%s/%s>",
+                    job.queue, job.namespace, job.name,
+                )
+                continue
+            if queue.uid not in queue_map:
+                queue_map[queue.uid] = queue
+                queues.push(queue)
+            if job.task_status_index.get(TaskStatus.PENDING):
+                if job.queue not in preemptors_map:
+                    preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+                preemptors_map[job.queue].push(job)
+                preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index[TaskStatus.PENDING].values():
+                    preemptor_tasks[job.uid].push(task)
+
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                continue
+            jobs = preemptors_map.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+            tasks = preemptor_tasks.get(job.uid)
+            if tasks is None or tasks.empty():
+                continue
+            task = tasks.pop()
+
+            assigned = False
+            for node in get_node_list(ssn.nodes):
+                try:
+                    ssn.predicate_fn(task, node)
+                except Exception:
+                    continue
+
+                resreq = task.init_resreq.clone()
+                reclaimed = Resource.empty()
+
+                reclaimees = []
+                for t in node.tasks.values():
+                    if t.status != TaskStatus.RUNNING:
+                        continue
+                    j = ssn.jobs.get(t.job)
+                    if j is None:
+                        continue
+                    if j.queue != job.queue:
+                        reclaimees.append(t.clone())
+                victims = ssn.reclaimable(task, reclaimees)
+                if not victims:
+                    continue
+
+                all_res = Resource.empty()
+                for v in victims:
+                    all_res.add(v.resreq)
+                if all_res.less(resreq):
+                    continue
+
+                for reclaimee in victims:
+                    try:
+                        ssn.evict(reclaimee, "reclaim")
+                    except Exception:
+                        logger.exception(
+                            "Failed to reclaim Task <%s/%s>",
+                            reclaimee.namespace, reclaimee.name,
+                        )
+                        continue
+                    reclaimed.add(reclaimee.resreq)
+                    if resreq.less_equal(reclaimed):
+                        break
+
+                if task.init_resreq.less_equal(reclaimed):
+                    try:
+                        ssn.pipeline(task, node.name)
+                    except Exception:
+                        # Corrected in next scheduling loop (reclaim.go:173-180)
+                        logger.exception(
+                            "Failed to pipeline Task <%s/%s> on <%s>",
+                            task.namespace, task.name, node.name,
+                        )
+                    assigned = True
+                    break
+
+            if assigned:
+                queues.push(queue)
+
+
+register_action(ReclaimAction())

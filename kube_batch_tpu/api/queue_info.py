@@ -13,7 +13,9 @@ QueueID = str
 
 class QueueInfo:
     def __init__(self, queue: Queue):
-        self.uid: QueueID = queue.metadata.uid or queue.name
+        # UID is the queue NAME (reference queue_info.go:77: jobs reference
+        # queues by name, and the cache keys queues by name too).
+        self.uid: QueueID = queue.name
         self.name = queue.name
         self.weight = queue.spec.weight
         self.queue = queue

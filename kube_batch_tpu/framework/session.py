@@ -1,0 +1,445 @@
+"""Session: the per-cycle world view and decision surface.
+
+Mirrors reference framework/session.go (:37 struct, :63 openSession,
+:119 closeSession, :146 jobStatus, :194 Pipeline, :237 Allocate,
+:294 dispatch, :321 Evict, :361 UpdateJobCondition) and
+framework/session_plugins.go (tiered combinator dispatch).
+
+The Session holds a deep-cloned snapshot; Allocate/Pipeline/Evict mutate the
+snapshot and fire plugin event handlers; gang dispatch happens the moment a
+job becomes Ready (session.go:281-289). This object is also what gets
+vectorized into the dense tensor snapshot for the TPU solver (ops.snapshot).
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _time
+import uuid as _uuid
+from typing import Callable, Dict, List, Optional
+
+from .. import metrics
+from ..api import (
+    POD_GROUP_CONDITION_UNSCHEDULABLE,
+    JobInfo,
+    NodeInfo,
+    PodGroupCondition,
+    PodGroupPhase,
+    QueueInfo,
+    TaskInfo,
+    TaskStatus,
+    ValidateResult,
+    allocated_status,
+)
+from ..conf import Tier
+from .event import Event, EventHandler
+
+logger = logging.getLogger(__name__)
+
+
+class Session:
+    def __init__(self, cache, tiers: Optional[List[Tier]] = None):
+        self.uid = str(_uuid.uuid4())
+        self.cache = cache
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.backlog: List[JobInfo] = []
+        self.tiers: List[Tier] = tiers or []
+
+        self.plugins: Dict[str, object] = {}
+        self.event_handlers: List[EventHandler] = []
+        self.job_order_fns: Dict[str, Callable] = {}
+        self.queue_order_fns: Dict[str, Callable] = {}
+        self.task_order_fns: Dict[str, Callable] = {}
+        self.predicate_fns: Dict[str, Callable] = {}
+        self.batch_predicate_fns: Dict[str, Callable] = {}
+        self.preemptable_fns: Dict[str, Callable] = {}
+        self.reclaimable_fns: Dict[str, Callable] = {}
+        self.overused_fns: Dict[str, Callable] = {}
+        self.job_ready_fns: Dict[str, Callable] = {}
+        self.job_pipelined_fns: Dict[str, Callable] = {}
+        self.job_valid_fns: Dict[str, Callable] = {}
+        self.node_order_fns: Dict[str, List] = {}
+
+    # ------------------------------------------------------------------ open
+
+    def _open(self) -> None:
+        """reference session.go:63-117"""
+        snapshot = self.cache.snapshot()
+        self.jobs = snapshot.jobs
+        self.nodes = snapshot.nodes
+        self.queues = snapshot.queues
+
+    def _validate_jobs(self) -> None:
+        """Drop invalid jobs, persisting an Unschedulable condition
+        (reference session.go:89-108). Called after plugins are opened so
+        JobValid callbacks are installed."""
+        for job in list(self.jobs.values()):
+            vr = self.job_valid(job)
+            if vr is not None and not vr.passed:
+                cond = PodGroupCondition(
+                    type=POD_GROUP_CONDITION_UNSCHEDULABLE,
+                    status="True",
+                    transition_id=self.uid,
+                    reason=vr.reason,
+                    message=vr.message,
+                )
+                try:
+                    self.update_job_condition(job, cond)
+                except KeyError:
+                    logger.exception("failed to update job condition")
+                del self.jobs[job.uid]
+
+    def _close(self) -> None:
+        """reference session.go:119-144"""
+        for job in self.jobs.values():
+            if job.pod_group is None:
+                self.cache.record_job_status_event(job)
+                continue
+            job.pod_group.status = self._job_status(job)
+            try:
+                self.cache.update_job_status(job)
+            except Exception:
+                logger.exception(
+                    "failed to update job <%s/%s>", job.namespace, job.name
+                )
+        self.jobs = {}
+        self.nodes = {}
+        self.backlog = []
+        self.plugins = {}
+        self.event_handlers = []
+        self.job_order_fns = {}
+        self.queue_order_fns = {}
+
+    def _job_status(self, job: JobInfo):
+        """Recompute PodGroup status (reference session.go:146-184)."""
+        status = job.pod_group.status
+        unschedulable = any(
+            c.type == POD_GROUP_CONDITION_UNSCHEDULABLE
+            and c.status == "True"
+            and c.transition_id == self.uid
+            for c in status.conditions
+        )
+        if job.task_status_index.get(TaskStatus.RUNNING) and unschedulable:
+            status.phase = PodGroupPhase.UNKNOWN
+        else:
+            allocated = sum(
+                len(tasks)
+                for st, tasks in job.task_status_index.items()
+                if allocated_status(st)
+            )
+            if allocated >= job.pod_group.spec.min_member:
+                status.phase = PodGroupPhase.RUNNING
+            else:
+                status.phase = PodGroupPhase.PENDING
+        status.running = len(job.task_status_index.get(TaskStatus.RUNNING, {}))
+        status.failed = len(job.task_status_index.get(TaskStatus.FAILED, {}))
+        status.succeeded = len(job.task_status_index.get(TaskStatus.SUCCEEDED, {}))
+        return status
+
+    # ------------------------------------------------------- state mutation
+
+    def statement(self) -> "Statement":
+        from .statement import Statement
+
+        return Statement(self)
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        """Place onto releasing resources, session-only (session.go:194-234)."""
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job} when pipelining")
+        job.update_task_status(task, TaskStatus.PIPELINED)
+        task.node_name = hostname
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        node.add_task(task)
+        for eh in self.event_handlers:
+            if eh.allocate_func is not None:
+                eh.allocate_func(Event(task))
+
+    def allocate(self, task: TaskInfo, hostname: str) -> None:
+        """Allocate in-session; dispatch the whole gang once JobReady
+        (reference session.go:237-292)."""
+        self.cache.allocate_volumes(task, hostname)
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        job.update_task_status(task, TaskStatus.ALLOCATED)
+        task.node_name = hostname
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        node.add_task(task)
+        for eh in self.event_handlers:
+            if eh.allocate_func is not None:
+                eh.allocate_func(Event(task))
+        if self.job_ready(job):
+            # Copy: dispatch mutates the Allocated index while we iterate.
+            for t in list(
+                job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()
+            ):
+                self.dispatch(t)
+
+    def dispatch(self, task: TaskInfo) -> None:
+        """Bind one gang member (reference session.go:294-318)."""
+        self.cache.bind_volumes(task)
+        self.cache.bind(task, task.node_name)
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        job.update_task_status(task, TaskStatus.BINDING)
+        # Time from pod creation to bind (reference session.go:316).
+        metrics.update_task_schedule_duration(
+            max(0.0, _time.time() - task.pod.metadata.creation_timestamp)
+        )
+
+    def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        """Direct eviction (reference session.go:321-358)."""
+        self.cache.evict(reclaimee, reason)
+        job = self.jobs.get(reclaimee.job)
+        if job is None:
+            raise KeyError(f"failed to find job {reclaimee.job}")
+        job.update_task_status(reclaimee, TaskStatus.RELEASING)
+        node = self.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        for eh in self.event_handlers:
+            if eh.deallocate_func is not None:
+                eh.deallocate_func(Event(reclaimee))
+
+    def update_job_condition(self, job_info: JobInfo, cond: PodGroupCondition) -> None:
+        """reference session.go:361-383"""
+        job = self.jobs.get(job_info.uid)
+        if job is None:
+            raise KeyError(
+                f"failed to find job <{job_info.namespace}/{job_info.name}>"
+            )
+        for i, c in enumerate(job.pod_group.status.conditions):
+            if c.type == cond.type:
+                job.pod_group.status.conditions[i] = cond
+                return
+        job.pod_group.status.conditions.append(cond)
+
+    def add_event_handler(self, eh: EventHandler) -> None:
+        self.event_handlers.append(eh)
+
+    # ------------------------------------------- callback registration API
+
+    def add_job_order_fn(self, name, fn):
+        self.job_order_fns[name] = fn
+
+    def add_queue_order_fn(self, name, fn):
+        self.queue_order_fns[name] = fn
+
+    def add_task_order_fn(self, name, fn):
+        self.task_order_fns[name] = fn
+
+    def add_predicate_fn(self, name, fn):
+        self.predicate_fns[name] = fn
+
+    def add_batch_predicate_fn(self, name, fn):
+        """TPU-native extension: vectorized predicate producing a [T,N] bool
+        mask for a whole task batch at once (consumed by ops.mask)."""
+        self.batch_predicate_fns[name] = fn
+
+    def add_preemptable_fn(self, name, fn):
+        self.preemptable_fns[name] = fn
+
+    def add_reclaimable_fn(self, name, fn):
+        self.reclaimable_fns[name] = fn
+
+    def add_overused_fn(self, name, fn):
+        self.overused_fns[name] = fn
+
+    def add_job_ready_fn(self, name, fn):
+        self.job_ready_fns[name] = fn
+
+    def add_job_pipelined_fn(self, name, fn):
+        self.job_pipelined_fns[name] = fn
+
+    def add_job_valid_fn(self, name, fn):
+        self.job_valid_fns[name] = fn
+
+    def add_node_order_fn(self, name, fn, weight: float = 1.0):
+        """Node scorers; (task, node) -> float, higher is better. The
+        reference plumbs k8s PriorityConfigs (session_plugins.go:354-369);
+        here scorers are plain weighted functions, and plugins may also
+        attach a ``batch_fn`` via add_batch_node_order_fn for the TPU path."""
+        self.node_order_fns.setdefault(name, []).append((fn, weight))
+
+    # ------------------------------------------------- tiered combinators
+    # reference framework/session_plugins.go
+
+    def _enabled(self, flag: Optional[bool]) -> bool:
+        return bool(flag)
+
+    def reclaimable(self, reclaimer: TaskInfo, reclaimees: List[TaskInfo]):
+        """Intersection within a tier; first deciding tier wins
+        (session_plugins.go:80-119)."""
+        return self._evictable(
+            reclaimer, reclaimees, self.reclaimable_fns, "enabled_reclaimable"
+        )
+
+    def preemptable(self, preemptor: TaskInfo, preemptees: List[TaskInfo]):
+        """session_plugins.go:121-162"""
+        return self._evictable(
+            preemptor, preemptees, self.preemptable_fns, "enabled_preemptable"
+        )
+
+    def _evictable(self, evictor, evictees, fns, flag_attr):
+        # Go-nil semantics matter here (session_plugins.go:80-119): a plugin
+        # answering "no victims" (nil) poisons every later intersection, and a
+        # tier only decides when its running intersection is non-empty.
+        victims: Optional[List[TaskInfo]] = None
+        init = False
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(getattr(plugin, flag_attr)):
+                    continue
+                fn = fns.get(plugin.name)
+                if fn is None:
+                    continue
+                candidates = fn(evictor, evictees) or None  # empty → Go nil
+                if not init:
+                    victims = candidates
+                    init = True
+                elif victims:
+                    cand_uids = {c.uid for c in (candidates or [])}
+                    victims = [v for v in victims if v.uid in cand_uids] or None
+            if victims is not None:
+                return victims
+        return victims or []
+
+    def overused(self, queue: QueueInfo) -> bool:
+        """Any-true across all tiers (session_plugins.go:164-179)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                fn = self.overused_fns.get(plugin.name)
+                if fn is not None and fn(queue):
+                    return True
+        return False
+
+    def job_ready(self, obj) -> bool:
+        """All-true (session_plugins.go:182-200)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_job_ready):
+                    continue
+                fn = self.job_ready_fns.get(plugin.name)
+                if fn is not None and not fn(obj):
+                    return False
+        return True
+
+    def job_pipelined(self, obj) -> bool:
+        """All-true (session_plugins.go:202-221)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_job_pipelined):
+                    continue
+                fn = self.job_pipelined_fns.get(plugin.name)
+                if fn is not None and not fn(obj):
+                    return False
+        return True
+
+    def job_valid(self, obj) -> Optional[ValidateResult]:
+        """First failure wins (session_plugins.go:224-240)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                fn = self.job_valid_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                vr = fn(obj)
+                if vr is not None and not vr.passed:
+                    return vr
+        return None
+
+    def job_order_fn(self, l: JobInfo, r: JobInfo) -> bool:
+        """First nonzero comparison; creation-time+UID tiebreak
+        (session_plugins.go:243-267)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_job_order):
+                    continue
+                fn = self.job_order_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                j = fn(l, r)
+                if j != 0:
+                    return j < 0
+        if l.creation_timestamp == r.creation_timestamp:
+            return l.uid < r.uid
+        return l.creation_timestamp < r.creation_timestamp
+
+    def queue_order_fn(self, l: QueueInfo, r: QueueInfo) -> bool:
+        """session_plugins.go:270-295"""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_queue_order):
+                    continue
+                fn = self.queue_order_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                j = fn(l, r)
+                if j != 0:
+                    return j < 0
+        lt = l.queue.metadata.creation_timestamp
+        rt = r.queue.metadata.creation_timestamp
+        if lt == rt:
+            return l.uid < r.uid
+        return lt < rt
+
+    def task_compare_fns(self, l: TaskInfo, r: TaskInfo) -> int:
+        """session_plugins.go:298-315"""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_task_order):
+                    continue
+                fn = self.task_order_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                j = fn(l, r)
+                if j != 0:
+                    return j
+        return 0
+
+    def task_order_fn(self, l: TaskInfo, r: TaskInfo) -> bool:
+        """session_plugins.go:318-331"""
+        res = self.task_compare_fns(l, r)
+        if res != 0:
+            return res < 0
+        lt = l.pod.metadata.creation_timestamp
+        rt = r.pod.metadata.creation_timestamp
+        if lt == rt:
+            return l.uid < r.uid
+        return lt < rt
+
+    def predicate_fn(self, task: TaskInfo, node: NodeInfo) -> None:
+        """All must pass; raises PredicateError on failure
+        (session_plugins.go:334-351)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_predicate):
+                    continue
+                fn = self.predicate_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                fn(task, node)  # raises on failure
+
+    def node_prioritizers(self) -> List:
+        """Concat enabled scorers (session_plugins.go:354-369)."""
+        configs: List = []
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_node_order):
+                    continue
+                configs.extend(self.node_order_fns.get(plugin.name, []))
+        return configs
+
+    def __repr__(self) -> str:
+        return (
+            f"Session {self.uid}: jobs={len(self.jobs)}, "
+            f"nodes={len(self.nodes)}, queues={len(self.queues)}"
+        )

@@ -1,0 +1,213 @@
+"""Scheduling metrics.
+
+Mirrors reference pkg/scheduler/metrics/metrics.go (:37-120 definitions,
+:122-170 update helpers): e2e/action/plugin/task scheduling latency
+histograms, schedule attempts, preemption counters, unschedulable gauges.
+The reference exports via Prometheus under namespace "volcano"
+(metrics.go:27); here a dependency-free registry with a Prometheus
+text-exposition dump serves the same purpose (served by cli.server).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Tuple
+
+NAMESPACE = "tpu_batch"
+
+# Default latency buckets (seconds), log-spaced like prometheus.DefBuckets.
+_DEF_BUCKETS = [
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+]
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str):
+        self.name = f"{NAMESPACE}_{name}"
+        self.help = help_text
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_text=""):
+        super().__init__(name, help_text)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, labels: Tuple = (), amount: float = 1.0) -> None:
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + amount
+
+    def get(self, labels: Tuple = ()) -> float:
+        return self._values.get(labels, 0.0)
+
+    def expose(self, label_names: Tuple = ()) -> List[str]:
+        lines = [f"# TYPE {self.name} counter"]
+        for labels, v in sorted(self._values.items()):
+            sel = ",".join(f'{n}="{val}"' for n, val in zip(label_names, labels))
+            lines.append(f"{self.name}{{{sel}}} {v}" if sel else f"{self.name} {v}")
+        return lines
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_text=""):
+        super().__init__(name, help_text)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, labels: Tuple = ()) -> None:
+        with self._lock:
+            self._values[labels] = value
+
+    def get(self, labels: Tuple = ()) -> float:
+        return self._values.get(labels, 0.0)
+
+    def expose(self, label_names: Tuple = ()) -> List[str]:
+        lines = [f"# TYPE {self.name} gauge"]
+        for labels, v in sorted(self._values.items()):
+            sel = ",".join(f'{n}="{val}"' for n, val in zip(label_names, labels))
+            lines.append(f"{self.name}{{{sel}}} {v}" if sel else f"{self.name} {v}")
+        return lines
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_text="", buckets: Optional[List[float]] = None):
+        super().__init__(name, help_text)
+        self.buckets = sorted(buckets or _DEF_BUCKETS)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, labels: Tuple = ()) -> None:
+        with self._lock:
+            if labels not in self._counts:
+                self._counts[labels] = [0] * len(self.buckets)
+            # Prometheus `le` is inclusive: value lands in the first bucket
+            # with bound >= value.
+            idx = bisect_left(self.buckets, value)
+            for i in range(idx, len(self.buckets)):
+                self._counts[labels][i] += 1
+            self._sums[labels] = self._sums.get(labels, 0.0) + value
+            self._totals[labels] = self._totals.get(labels, 0) + 1
+
+    def count(self, labels: Tuple = ()) -> int:
+        return self._totals.get(labels, 0)
+
+    def sum(self, labels: Tuple = ()) -> float:
+        return self._sums.get(labels, 0.0)
+
+    def expose(self, label_names: Tuple = ()) -> List[str]:
+        lines = [f"# TYPE {self.name} histogram"]
+        for labels in sorted(self._totals):
+            base = ",".join(f'{n}="{val}"' for n, val in zip(label_names, labels))
+            for b, c in zip(self.buckets, self._counts[labels]):
+                sel = f'{base},le="{b}"' if base else f'le="{b}"'
+                lines.append(f"{self.name}_bucket{{{sel}}} {c}")
+            inf_sel = f'{base},le="+Inf"' if base else 'le="+Inf"'
+            lines.append(
+                f"{self.name}_bucket{{{inf_sel}}} {self._totals[labels]}"
+            )
+            sel = f"{{{base}}}" if base else ""
+            lines.append(f"{self.name}_sum{sel} {self._sums[labels]}")
+            lines.append(f"{self.name}_count{sel} {self._totals[labels]}")
+        return lines
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[Tuple[_Metric, Tuple]] = []
+
+    def register(self, metric: _Metric, label_names: Tuple = ()):
+        self._metrics.append((metric, label_names))
+        return metric
+
+    def expose_text(self) -> str:
+        lines: List[str] = []
+        for metric, label_names in self._metrics:
+            lines.extend(metric.expose(label_names))
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# Metric set mirrors reference metrics.go:37-120.
+e2e_scheduling_latency = REGISTRY.register(
+    Histogram("e2e_scheduling_latency_seconds", "E2E scheduling latency")
+)
+plugin_scheduling_latency = REGISTRY.register(
+    Histogram("plugin_scheduling_latency_seconds", "Plugin latency"),
+    ("plugin", "OnSession"),
+)
+action_scheduling_latency = REGISTRY.register(
+    Histogram("action_scheduling_latency_seconds", "Action latency"), ("action",)
+)
+task_scheduling_latency = REGISTRY.register(
+    Histogram("task_scheduling_latency_seconds", "Task scheduling latency")
+)
+schedule_attempts = REGISTRY.register(
+    Counter("schedule_attempts_total", "Scheduling attempts by result"),
+    ("result",),
+)
+preemption_victims = REGISTRY.register(
+    Gauge("pod_preemption_victims", "Number of selected preemption victims")
+)
+preemption_attempts = REGISTRY.register(
+    Counter("total_preemption_attempts", "Total preemption attempts")
+)
+unschedule_task_count = REGISTRY.register(
+    Gauge("unschedule_task_count", "Unschedulable tasks per job"), ("job_id",)
+)
+unschedule_job_count = REGISTRY.register(
+    Gauge("unschedule_job_count", "Number of unschedulable jobs")
+)
+job_retry_count = REGISTRY.register(
+    Counter("job_retry_counts", "Job retries"), ("job_id",)
+)
+pod_group_phase_count = REGISTRY.register(
+    Gauge("pod_group_phase_count", "PodGroups per phase"), ("phase",)
+)
+solver_iterations = REGISTRY.register(
+    Gauge("solver_iterations", "TPU solver rounds used in the last cycle")
+)
+
+
+# Update helpers (reference metrics.go:122-170).
+
+def update_e2e_duration(seconds: float) -> None:
+    e2e_scheduling_latency.observe(seconds)
+
+
+def update_plugin_duration(plugin: str, on_session: str, seconds: float) -> None:
+    plugin_scheduling_latency.observe(seconds, (plugin, on_session))
+
+
+def update_action_duration(action: str, seconds: float) -> None:
+    action_scheduling_latency.observe(seconds, (action,))
+
+
+def update_task_schedule_duration(seconds: float) -> None:
+    task_scheduling_latency.observe(seconds)
+
+
+def update_pod_group_phase(phase: str, count: int) -> None:
+    pod_group_phase_count.set(count, (phase,))
+
+
+def update_preemption_victims(count: int) -> None:
+    preemption_victims.set(count)
+
+
+def register_preemption_attempts() -> None:
+    preemption_attempts.inc()
+
+
+def update_unschedulable_task_count(job_id: str, count: int) -> None:
+    unschedule_task_count.set(count, (job_id,))
+
+
+def update_unschedulable_job_count(count: int) -> None:
+    unschedule_job_count.set(count)
+
+
+def register_job_retries(job_id: str) -> None:
+    job_retry_count.inc((job_id,))

@@ -1,0 +1,108 @@
+"""DRF plugin: Dominant Resource Fairness across jobs.
+
+Mirrors reference plugins/drf/drf.go:
+- Per-job share = max over resources of allocated/clusterTotal (:161-172).
+- PreemptableFn: victim ok if preemptor's post-transfer share stays below (or
+  within shareDelta of) the victim's (:85-108).
+- JobOrderFn: lower share first (:115-132).
+- Event handlers keep allocated+share incrementally updated (:137-157).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..api import JobInfo, Resource, allocated_status, share as share_fn
+from ..framework import EventHandler, Plugin, register_plugin_builder
+
+SHARE_DELTA = 0.000001  # reference drf.go:29
+
+
+class _DrfAttr:
+    __slots__ = ("allocated", "share")
+
+    def __init__(self):
+        self.allocated = Resource.empty()
+        self.share = 0.0
+
+
+class DrfPlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+        self.total_resource = Resource.empty()
+        self.job_attrs: Dict[str, _DrfAttr] = {}
+
+    def name(self) -> str:
+        return "drf"
+
+    def _calculate_share(self, allocated: Resource, total: Resource) -> float:
+        res = 0.0
+        for rn in total.resource_names():
+            s = share_fn(allocated.get(rn), total.get(rn))
+            if s > res:
+                res = s
+        return res
+
+    def _update_share(self, attr: _DrfAttr) -> None:
+        attr.share = self._calculate_share(attr.allocated, self.total_resource)
+
+    def on_session_open(self, ssn) -> None:
+        for node in ssn.nodes.values():
+            self.total_resource.add(node.allocatable)
+
+        for job in ssn.jobs.values():
+            attr = _DrfAttr()
+            for status, tasks in job.task_status_index.items():
+                if allocated_status(status):
+                    for t in tasks.values():
+                        attr.allocated.add(t.resreq)
+            self._update_share(attr)
+            self.job_attrs[job.uid] = attr
+
+        def preemptable_fn(preemptor, preemptees):
+            victims = []
+            latt = self.job_attrs[preemptor.job]
+            lalloc = latt.allocated.clone().add(preemptor.resreq)
+            ls = self._calculate_share(lalloc, self.total_resource)
+            allocations: Dict[str, Resource] = {}
+            for preemptee in preemptees:
+                if preemptee.job not in allocations:
+                    allocations[preemptee.job] = self.job_attrs[
+                        preemptee.job
+                    ].allocated.clone()
+                ralloc = allocations[preemptee.job].sub(preemptee.resreq)
+                rs = self._calculate_share(ralloc, self.total_resource)
+                if ls < rs or abs(ls - rs) <= SHARE_DELTA:
+                    victims.append(preemptee)
+            return victims
+
+        ssn.add_preemptable_fn(self.name(), preemptable_fn)
+
+        def job_order_fn(l: JobInfo, r: JobInfo) -> int:
+            ls, rs = self.job_attrs[l.uid].share, self.job_attrs[r.uid].share
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        ssn.add_job_order_fn(self.name(), job_order_fn)
+
+        def on_allocate(event):
+            attr = self.job_attrs[event.task.job]
+            attr.allocated.add(event.task.resreq)
+            self._update_share(attr)
+
+        def on_deallocate(event):
+            attr = self.job_attrs[event.task.job]
+            attr.allocated.sub(event.task.resreq)
+            self._update_share(attr)
+
+        ssn.add_event_handler(
+            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+        )
+
+    def on_session_close(self, ssn) -> None:
+        self.total_resource = Resource.empty()
+        self.job_attrs = {}
+
+
+register_plugin_builder("drf", lambda args: DrfPlugin(args))

@@ -1,0 +1,244 @@
+"""Predicates plugin: node feasibility checks.
+
+Mirrors reference plugins/predicates/predicates.go (:113-265), which delegates
+to the vendored k8s default-scheduler predicates. Implemented natively here
+against the standalone object model, same check set and order:
+- MaxTaskNum pod-count (:128)
+- CheckNodeCondition (:133) — node Ready, not under unschedulable taint
+- CheckNodeUnschedulable via spec (:147)
+- PodMatchNodeSelector incl. required node affinity (:161)
+- PodFitsHostPorts (:175)
+- PodToleratesNodeTaints (:189)
+- Memory/Disk/PID pressure, gated by plugin arguments
+  predicate.{Memory,Disk,PID}PressureEnable (:75-110, :203-249)
+- Inter-pod affinity/anti-affinity over session state (:252-262)
+
+Each predicate raises PredicateError(reason) on rejection. The plugin also
+registers a *batch* predicate (TPU-native extension) that evaluates the
+static checks for a whole task batch as a [T, N] numpy mask — used by
+ops.snapshot to build the device-side feasibility mask without a Python
+per-(task, node) loop.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..api import NodeInfo, TaskInfo
+from ..framework import Plugin, register_plugin_builder
+from .util import (
+    PredicateError,
+    SessionPodLister,
+    match_label_selector,
+    match_node_selector_terms,
+)
+
+# Argument keys (reference predicates.go:75-95).
+MEMORY_PRESSURE_ENABLE = "predicate.MemoryPressureEnable"
+DISK_PRESSURE_ENABLE = "predicate.DiskPressureEnable"
+PID_PRESSURE_ENABLE = "predicate.PIDPressureEnable"
+
+
+def _node_condition(node: NodeInfo, cond_type: str) -> str:
+    if node.node is None:
+        return "Unknown"
+    for c in node.node.status.conditions:
+        if c.type == cond_type:
+            return c.status
+    return ""
+
+
+def check_node_condition(task: TaskInfo, node: NodeInfo) -> None:
+    """Node must be Ready and not OutOfDisk (k8s CheckNodeCondition)."""
+    ready = _node_condition(node, "Ready")
+    if ready not in ("", "True"):
+        raise PredicateError("NodeNotReady", f"node {node.name} is not ready")
+    if _node_condition(node, "OutOfDisk") == "True":
+        raise PredicateError("NodeOutOfDisk", f"node {node.name} is out of disk")
+
+
+def check_node_unschedulable(task: TaskInfo, node: NodeInfo) -> None:
+    if node.node is not None and node.node.spec.unschedulable:
+        raise PredicateError(
+            "NodeUnschedulable", f"node {node.name} is unschedulable"
+        )
+
+
+def check_max_task_num(task: TaskInfo, node: NodeInfo) -> None:
+    """reference predicates.go:128-131"""
+    if len(node.tasks) >= node.allocatable.max_task_num > 0:
+        raise PredicateError(
+            "NodePodNumberExceeded",
+            f"node {node.name} has {len(node.tasks)} tasks, "
+            f"max {node.allocatable.max_task_num}",
+        )
+
+
+def pod_match_node_selector(task: TaskInfo, node: NodeInfo) -> None:
+    """nodeSelector + required node affinity (k8s PodMatchNodeSelector)."""
+    labels = node.node.metadata.labels if node.node else {}
+    if task.pod.spec.node_selector and not match_label_selector(
+        task.pod.spec.node_selector, labels
+    ):
+        raise PredicateError(
+            "MatchNodeSelector", f"node {node.name} does not match node selector"
+        )
+    affinity = task.pod.spec.affinity
+    if affinity and affinity.node_required is not None:
+        if not match_node_selector_terms(affinity.node_required, labels):
+            raise PredicateError(
+                "MatchNodeSelector",
+                f"node {node.name} does not match required node affinity",
+            )
+
+
+def pod_fits_host_ports(task: TaskInfo, node: NodeInfo) -> None:
+    wanted = set()
+    for c in task.pod.spec.containers:
+        wanted.update(c.ports)
+    if not wanted:
+        return
+    for other in node.tasks.values():
+        for c in other.pod.spec.containers:
+            if wanted.intersection(c.ports):
+                raise PredicateError(
+                    "PodFitsHostPorts", f"host port conflict on {node.name}"
+                )
+
+
+def pod_tolerates_node_taints(task: TaskInfo, node: NodeInfo) -> None:
+    if node.node is None:
+        return
+    for taint in node.node.spec.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue  # PreferNoSchedule is a soft constraint
+        if not any(t.tolerates(taint) for t in task.pod.spec.tolerations):
+            raise PredicateError(
+                "PodToleratesNodeTaints",
+                f"taint {taint.key}={taint.value}:{taint.effect} not tolerated",
+            )
+
+
+def _check_pressure(node: NodeInfo, cond_type: str, reason: str) -> None:
+    if _node_condition(node, cond_type) == "True":
+        raise PredicateError(reason, f"node under {cond_type}")
+
+
+class PredicatesPlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+
+    def name(self) -> str:
+        return "predicates"
+
+    def _pressure_flags(self):
+        getb = getattr(self.arguments, "get_bool", None)
+        if getb is None:
+            return False, False, False
+        return (
+            bool(getb(MEMORY_PRESSURE_ENABLE, False)),
+            bool(getb(DISK_PRESSURE_ENABLE, False)),
+            bool(getb(PID_PRESSURE_ENABLE, False)),
+        )
+
+    def on_session_open(self, ssn) -> None:
+        mem_enable, disk_enable, pid_enable = self._pressure_flags()
+        lister = SessionPodLister(ssn)
+
+        def check_pod_affinity(task: TaskInfo, node: NodeInfo) -> None:
+            """Simplified inter-pod (anti-)affinity with node-level topology
+            (reference predicates.go:252-262 via vendored k8s checker)."""
+            affinity = task.pod.spec.affinity
+            if affinity is None:
+                return
+            on_node = lister.pods_on_node(node.name)
+            for term in affinity.pod_affinity or []:
+                sel = term.get("label_selector", {})
+                if not any(
+                    match_label_selector(sel, t.pod.metadata.labels)
+                    for t in on_node
+                ):
+                    raise PredicateError(
+                        "MatchInterPodAffinity",
+                        f"pod affinity not satisfied on {node.name}",
+                    )
+            for term in affinity.pod_anti_affinity or []:
+                sel = term.get("label_selector", {})
+                if any(
+                    match_label_selector(sel, t.pod.metadata.labels)
+                    for t in on_node
+                    if t.uid != task.uid
+                ):
+                    raise PredicateError(
+                        "MatchInterPodAntiAffinity",
+                        f"pod anti-affinity violated on {node.name}",
+                    )
+
+        def predicate_fn(task: TaskInfo, node: NodeInfo) -> None:
+            """reference predicates.go:124-264, same check order."""
+            check_max_task_num(task, node)
+            check_node_condition(task, node)
+            check_node_unschedulable(task, node)
+            pod_match_node_selector(task, node)
+            pod_fits_host_ports(task, node)
+            pod_tolerates_node_taints(task, node)
+            if mem_enable:
+                _check_pressure(node, "MemoryPressure", "NodeUnderMemoryPressure")
+            if disk_enable:
+                _check_pressure(node, "DiskPressure", "NodeUnderDiskPressure")
+            if pid_enable:
+                _check_pressure(node, "PIDPressure", "NodeUnderPIDPressure")
+            check_pod_affinity(task, node)
+
+        ssn.add_predicate_fn(self.name(), predicate_fn)
+
+        def batch_predicate_fn(
+            tasks: List[TaskInfo], nodes: List[NodeInfo]
+        ) -> np.ndarray:
+            """[T, N] bool mask of the static (non-pod-affinity) predicates,
+            vectorized per node column. Pod-affinity terms fall back to the
+            scalar path for the few tasks that carry them."""
+            T, N = len(tasks), len(nodes)
+            mask = np.ones((T, N), dtype=bool)
+            for j, node in enumerate(nodes):
+                node_ok = True
+                try:
+                    check_node_condition(tasks[0] if tasks else None, node)
+                    check_node_unschedulable(None, node)
+                    if mem_enable:
+                        _check_pressure(node, "MemoryPressure", "x")
+                    if disk_enable:
+                        _check_pressure(node, "DiskPressure", "x")
+                    if pid_enable:
+                        _check_pressure(node, "PIDPressure", "x")
+                except PredicateError:
+                    node_ok = False
+                if not node_ok:
+                    mask[:, j] = False
+                    continue
+                full = (
+                    0 < node.allocatable.max_task_num <= len(node.tasks)
+                )
+                if full:
+                    mask[:, j] = False
+                    continue
+                for i, task in enumerate(tasks):
+                    try:
+                        pod_match_node_selector(task, node)
+                        pod_fits_host_ports(task, node)
+                        pod_tolerates_node_taints(task, node)
+                        aff = task.pod.spec.affinity
+                        if aff is not None and (
+                            aff.pod_affinity or aff.pod_anti_affinity
+                        ):
+                            check_pod_affinity(task, node)
+                    except PredicateError:
+                        mask[i, j] = False
+            return mask
+
+        ssn.add_batch_predicate_fn(self.name(), batch_predicate_fn)
+
+
+register_plugin_builder("predicates", lambda args: PredicatesPlugin(args))

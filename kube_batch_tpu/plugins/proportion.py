@@ -1,0 +1,166 @@
+"""Proportion plugin: queue-level weighted fair share via water-filling.
+
+Mirrors reference plugins/proportion/proportion.go:
+- Iterative water-filling distributes cluster capacity to queues by weight
+  until remaining is empty or every queue's request is met (:100-147).
+- QueueOrderFn by share = max(allocated/deserved) (:156-168, :241-253).
+- ReclaimableFn: victim ok if its queue stays >= deserved after removal
+  (:171-195).
+- OverusedFn: deserved <= allocated (:198-208).
+- Event handlers keep allocated/share live (:211-234).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..api import (
+    QueueInfo,
+    Resource,
+    allocated_status,
+    min_resource,
+    share as share_fn,
+)
+from ..api.types import TaskStatus
+from ..framework import EventHandler, Plugin, register_plugin_builder
+
+
+class _QueueAttr:
+    __slots__ = ("queue_id", "name", "weight", "deserved", "allocated", "request", "share")
+
+    def __init__(self, queue_id: str, name: str, weight: int):
+        self.queue_id = queue_id
+        self.name = name
+        self.weight = weight
+        self.deserved = Resource.empty()
+        self.allocated = Resource.empty()
+        self.request = Resource.empty()
+        self.share = 0.0
+
+
+class ProportionPlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+        self.total_resource = Resource.empty()
+        self.queue_attrs: Dict[str, _QueueAttr] = {}
+
+    def name(self) -> str:
+        return "proportion"
+
+    def _update_share(self, attr: _QueueAttr) -> None:
+        res = 0.0
+        for rn in attr.deserved.resource_names():
+            s = share_fn(attr.allocated.get(rn), attr.deserved.get(rn))
+            if s > res:
+                res = s
+        attr.share = res
+
+    def on_session_open(self, ssn) -> None:
+        for node in ssn.nodes.values():
+            self.total_resource.add(node.allocatable)
+
+        # Build queue attributes from jobs (reference :66-99).
+        for job in ssn.jobs.values():
+            if job.queue not in ssn.queues:
+                continue
+            if job.queue not in self.queue_attrs:
+                queue = ssn.queues[job.queue]
+                self.queue_attrs[queue.uid] = _QueueAttr(
+                    queue.uid, queue.name, queue.weight
+                )
+            attr = self.queue_attrs[job.queue]
+            for status, tasks in job.task_status_index.items():
+                if allocated_status(status):
+                    for t in tasks.values():
+                        attr.allocated.add(t.resreq)
+                        attr.request.add(t.resreq)
+                elif status == TaskStatus.PENDING:
+                    for t in tasks.values():
+                        attr.request.add(t.resreq)
+
+        # Water-filling (reference :100-147).
+        remaining = self.total_resource.clone()
+        meet: Dict[str, bool] = {}
+        while True:
+            total_weight = sum(
+                a.weight for a in self.queue_attrs.values() if a.queue_id not in meet
+            )
+            if total_weight == 0:
+                break
+            increased = Resource.empty()
+            decreased = Resource.empty()
+            for attr in self.queue_attrs.values():
+                if attr.queue_id in meet:
+                    continue
+                old_deserved = attr.deserved.clone()
+                attr.deserved.add(
+                    remaining.clone().multi(attr.weight / total_weight)
+                )
+                if attr.request.less(attr.deserved):
+                    attr.deserved = min_resource(attr.deserved, attr.request)
+                    meet[attr.queue_id] = True
+                self._update_share(attr)
+                inc, dec = attr.deserved.diff(old_deserved)
+                increased.add(inc)
+                decreased.add(dec)
+            remaining.sub(increased)
+            remaining.add(decreased)
+            if remaining.is_empty():
+                break
+
+        def queue_order_fn(l: QueueInfo, r: QueueInfo) -> int:
+            ls, rs = self.queue_attrs[l.uid].share, self.queue_attrs[r.uid].share
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        ssn.add_queue_order_fn(self.name(), queue_order_fn)
+
+        def reclaimable_fn(reclaimer, reclaimees):
+            victims = []
+            allocations: Dict[str, Resource] = {}
+            for reclaimee in reclaimees:
+                job = ssn.jobs[reclaimee.job]
+                attr = self.queue_attrs[job.queue]
+                if job.queue not in allocations:
+                    allocations[job.queue] = attr.allocated.clone()
+                allocated = allocations[job.queue]
+                if allocated.less(reclaimee.resreq):
+                    continue
+                allocated.sub(reclaimee.resreq)
+                if attr.deserved.less_equal(allocated):
+                    victims.append(reclaimee)
+            return victims
+
+        ssn.add_reclaimable_fn(self.name(), reclaimable_fn)
+
+        def overused_fn(queue: QueueInfo) -> bool:
+            attr = self.queue_attrs.get(queue.uid)
+            if attr is None:
+                return False
+            return attr.deserved.less_equal(attr.allocated)
+
+        ssn.add_overused_fn(self.name(), overused_fn)
+
+        def on_allocate(event):
+            job = ssn.jobs[event.task.job]
+            attr = self.queue_attrs[job.queue]
+            attr.allocated.add(event.task.resreq)
+            self._update_share(attr)
+
+        def on_deallocate(event):
+            job = ssn.jobs[event.task.job]
+            attr = self.queue_attrs[job.queue]
+            attr.allocated.sub(event.task.resreq)
+            self._update_share(attr)
+
+        ssn.add_event_handler(
+            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+        )
+
+    def on_session_close(self, ssn) -> None:
+        self.total_resource = Resource.empty()
+        self.queue_attrs = {}
+
+
+register_plugin_builder("proportion", lambda args: ProportionPlugin(args))

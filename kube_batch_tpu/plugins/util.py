@@ -1,0 +1,79 @@
+"""Shared plugin utilities.
+
+Mirrors reference pkg/scheduler/plugins/util/util.go: the PodLister analog
+(session pods with session-assigned node names projected on, :31-85) used by
+pod-(anti)affinity evaluation, plus the predicate failure type.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api import NodeInfo, Pod, TaskInfo, TaskStatus
+
+
+class PredicateError(Exception):
+    """A predicate rejection; carries a machine-readable reason."""
+
+    def __init__(self, reason: str, message: str = ""):
+        self.reason = reason
+        self.message = message or reason
+        super().__init__(self.message)
+
+
+class SessionPodLister:
+    """Lists session pods with the session's current node assignment
+    (reference plugins/util/util.go:31-85: pods whose task moved in-session
+    get a copy with NodeName updated)."""
+
+    def __init__(self, ssn):
+        self.ssn = ssn
+
+    def tasks(self) -> List[TaskInfo]:
+        out = []
+        for job in self.ssn.jobs.values():
+            out.extend(job.tasks.values())
+        return out
+
+    def pods_on_node(self, node_name: str) -> List[TaskInfo]:
+        out = []
+        for task in self.tasks():
+            if task.node_name == node_name and task.status in (
+                TaskStatus.RUNNING,
+                TaskStatus.ALLOCATED,
+                TaskStatus.PIPELINED,
+                TaskStatus.BINDING,
+                TaskStatus.BOUND,
+            ):
+                out.append(task)
+        return out
+
+
+def match_label_selector(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    """Plain equality-based selector match."""
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def match_node_selector_terms(expressions: Optional[List[Dict]], labels: Dict[str, str]) -> bool:
+    """Evaluate node-affinity match expressions (In/NotIn/Exists/DoesNotExist)."""
+    if not expressions:
+        return True
+    for expr in expressions:
+        key = expr.get("key", "")
+        op = expr.get("operator", "In")
+        values = expr.get("values", []) or []
+        if op == "In":
+            if labels.get(key) not in values:
+                return False
+        elif op == "NotIn":
+            if labels.get(key) in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:
+            return False
+    return True

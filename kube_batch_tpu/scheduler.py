@@ -1,0 +1,90 @@
+"""Scheduler core loop.
+
+Mirrors reference pkg/scheduler/scheduler.go (:35 struct, :45 NewScheduler,
+:63 Run — wait.Until(runOnce, period), :88 runOnce: OpenSession → execute
+configured actions in order → CloseSession, with per-action latency metrics)
+and pkg/scheduler/util.go (:44 loadSchedulerConf, :32 defaultSchedulerConf).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from . import metrics
+from .conf import DEFAULT_SCHEDULER_CONF, Tier, parse_scheduler_conf
+from .framework import Action, close_session, get_action, open_session
+
+logger = logging.getLogger(__name__)
+
+
+def load_scheduler_conf(confstr: str) -> Tuple[List[Action], List[Tier]]:
+    """YAML policy → (ordered actions, plugin tiers). Misconfigured action
+    names are a hard error (reference scheduler/util.go:44-72)."""
+    conf = parse_scheduler_conf(confstr)
+    actions: List[Action] = []
+    for name in conf.actions.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        action, found = get_action(name)
+        if not found:
+            raise ValueError(f"failed to find Action {name}, ignore it")
+        actions.append(action)
+    return actions, conf.tiers
+
+
+class Scheduler:
+    def __init__(
+        self,
+        cache,
+        scheduler_conf: Optional[str] = None,
+        schedule_period: float = 1.0,
+    ):
+        """scheduler_conf: YAML policy string or path to one; defaults to the
+        reference default policy (allocate, backfill; 2 plugin tiers)."""
+        # Ensure builtin registries are populated (blank-import analog,
+        # reference cmd/kube-batch/main.go:33-35).
+        from . import actions as _actions  # noqa: F401
+        from . import plugins as _plugins  # noqa: F401
+
+        self.cache = cache
+        self.schedule_period = schedule_period
+        confstr = scheduler_conf or DEFAULT_SCHEDULER_CONF
+        if "\n" not in confstr and confstr.endswith((".yaml", ".yml")):
+            with open(confstr) as f:
+                confstr = f.read()
+        self.actions, self.tiers = load_scheduler_conf(confstr)
+
+    def run(self, stop_event: Optional[threading.Event] = None) -> None:
+        """reference scheduler.go:63-85"""
+        stop = stop_event or threading.Event()
+        self.cache.run(stop)
+        self.cache.wait_for_cache_sync(stop)
+        while not stop.is_set():
+            start = time.perf_counter()
+            try:
+                self.run_once()
+            except Exception:
+                logger.exception("scheduling cycle failed")
+            elapsed = time.perf_counter() - start
+            stop.wait(max(0.0, self.schedule_period - elapsed))
+
+    def run_once(self) -> None:
+        """One scheduling cycle (reference scheduler.go:88-103)."""
+        cycle_start = time.perf_counter()
+        ssn = open_session(self.cache, self.tiers)
+        try:
+            for action in self.actions:
+                action_start = time.perf_counter()
+                action.initialize()
+                action.execute(ssn)
+                action.un_initialize()
+                metrics.update_action_duration(
+                    action.name(), time.perf_counter() - action_start
+                )
+        finally:
+            close_session(ssn)
+        metrics.update_e2e_duration(time.perf_counter() - cycle_start)

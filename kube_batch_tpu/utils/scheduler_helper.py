@@ -1,0 +1,71 @@
+"""Per-task node filter/score helpers used by the greedy actions.
+
+Mirrors reference pkg/scheduler/util/scheduler_helper.go (:63 PredicateNodes,
+:89 PrioritizeNodes weighted sum, :174 SortNodes, :188 SelectBestNode random
+among max). The reference parallelizes with 16 goroutines; the greedy Python
+path is the measured baseline only — the production path is the batched TPU
+solve in ops/, which replaces this entire per-task machinery.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, List, Sequence, Tuple
+
+from ..api import NodeInfo, TaskInfo
+
+# (node_name, score) pairs, higher is better.
+HostPriorityList = List[Tuple[str, float]]
+
+
+def predicate_nodes(
+    task: TaskInfo, nodes: Sequence[NodeInfo], fn: Callable
+) -> List[NodeInfo]:
+    """Nodes passing the predicate; fn raises on failure
+    (scheduler_helper.go:63-86)."""
+    out: List[NodeInfo] = []
+    for node in nodes:
+        try:
+            fn(task, node)
+        except Exception:
+            continue
+        out.append(node)
+    return out
+
+
+def prioritize_nodes(
+    task: TaskInfo,
+    nodes: Sequence[NodeInfo],
+    prioritizers: Sequence[Tuple[Callable, float]],
+) -> HostPriorityList:
+    """Weighted score sum per node (scheduler_helper.go:89-171)."""
+    result: HostPriorityList = []
+    for node in nodes:
+        score = 0.0
+        for fn, weight in prioritizers:
+            score += weight * fn(task, node)
+        result.append((node.name, score))
+    return result
+
+
+def sort_nodes(
+    priority_list: HostPriorityList, nodes_info: Dict[str, NodeInfo]
+) -> List[NodeInfo]:
+    """Nodes in descending score order (scheduler_helper.go:174-185)."""
+    ordered = sorted(priority_list, key=lambda hp: hp[1], reverse=True)
+    return [nodes_info[name] for name, _ in ordered]
+
+
+def select_best_node(priority_list: HostPriorityList) -> str:
+    """Highest score, random among ties (scheduler_helper.go:188-208)."""
+    if not priority_list:
+        raise ValueError("empty priority list")
+    max_score = max(s for _, s in priority_list)
+    best = [name for name, s in priority_list if s == max_score]
+    return random.choice(best)
+
+
+def get_node_list(nodes: Dict[str, NodeInfo]) -> List[NodeInfo]:
+    """Stable order for determinism (reference returns map order,
+    scheduler_helper.go:211-216)."""
+    return [nodes[name] for name in sorted(nodes)]

@@ -1,0 +1,282 @@
+"""Action-level integration tests with a fake cluster.
+
+Port of the reference pattern (actions/allocate/allocate_test.go:38,
+preempt_test.go:37, reclaim_test.go:37): build a real SchedulerCache directly
+(no watches) with fake side-effect seams, feed synthetic objects through the
+real event-handler entry points, open a real Session with explicit tiers,
+run the action, then assert bindings/evictions by draining the fake channels.
+"""
+
+import queue as queue_mod
+
+import pytest
+
+import kube_batch_tpu.actions  # noqa: F401 - registers actions
+import kube_batch_tpu.plugins  # noqa: F401 - registers plugins
+from kube_batch_tpu.api import PodPhase, TaskStatus, build_resource_list
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.conf import PluginOption, Tier, apply_plugin_conf_defaults
+from kube_batch_tpu.framework import close_session, get_action, open_session
+from kube_batch_tpu.utils.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+
+def make_cache():
+    return SchedulerCache(
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+
+
+def make_tiers(*names_per_tier):
+    tiers = []
+    for names in names_per_tier:
+        opts = []
+        for name in names:
+            opt = PluginOption(name=name)
+            apply_plugin_conf_defaults(opt)
+            opts.append(opt)
+        tiers.append(Tier(plugins=opts))
+    return tiers
+
+
+DEFAULT_TIERS_ARGS = (
+    ["priority", "gang", "conformance"],
+    ["drf", "predicates", "proportion", "nodeorder"],
+)
+
+
+def drain(channel, n, timeout=3.0):
+    out = []
+    for _ in range(n):
+        try:
+            out.append(channel.get(timeout=timeout))
+        except queue_mod.Empty:
+            break
+    return out
+
+
+def run_action(cache, action_name, tiers_args=DEFAULT_TIERS_ARGS):
+    tiers = make_tiers(*tiers_args)
+    ssn = open_session(cache, tiers)
+    action, found = get_action(action_name)
+    assert found
+    action.execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+def req(cpu="1", mem="1Gi"):
+    return build_resource_list(cpu=cpu, memory=mem)
+
+
+class TestAllocate:
+    def test_gang_fits_and_binds(self):
+        # The example/job.yaml scenario: one PodGroup minMember=3, one queue.
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=3))
+        for i in range(3):
+            c.add_pod(build_pod("ns", f"p{i}", "", PodPhase.PENDING, req(),
+                                group_name="pg1"))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+        c.add_node(build_node("n2", build_resource_list(cpu="2", memory="4Gi")))
+
+        run_action(c, "allocate")
+        binds = drain(c.binder.channel, 3)
+        assert len(binds) == 3
+        assert set(c.binder.binds) == {"ns/p0", "ns/p1", "ns/p2"}
+        # capacity respected: no node holds more than 2 cpus of binds
+        per_node = {}
+        for pod_key, host in c.binder.binds.items():
+            per_node[host] = per_node.get(host, 0) + 1
+        assert all(v <= 2 for v in per_node.values())
+
+    def test_gang_starved_binds_nothing(self):
+        # minMember=3 but only 2 cpus in the cluster: all-or-nothing.
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=3))
+        for i in range(3):
+            c.add_pod(build_pod("ns", f"p{i}", "", PodPhase.PENDING, req(),
+                                group_name="pg1"))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+
+        run_action(c, "allocate")
+        assert drain(c.binder.channel, 1, timeout=0.3) == []
+        assert not c.binder.binds
+
+    def test_two_jobs_share_cluster(self):
+        # Reference TestAllocate "two jobs" case: 2 pods each, capacity 2+2.
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        for g in ("pg1", "pg2"):
+            c.add_pod_group(build_pod_group(g, namespace="ns", min_member=1))
+            for i in range(2):
+                c.add_pod(build_pod("ns", f"{g}-p{i}", "", PodPhase.PENDING,
+                                    req(), group_name=g))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+        c.add_node(build_node("n2", build_resource_list(cpu="2", memory="4Gi")))
+
+        run_action(c, "allocate")
+        binds = drain(c.binder.channel, 4)
+        assert len(binds) == 4
+
+    def test_unschedulable_gang_gets_condition(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        # Only 2 pods exist for minMember=3: JobValid drops the job with a
+        # NotEnoughTasks condition.
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=3))
+        for i in range(2):
+            c.add_pod(build_pod("ns", f"p{i}", "", PodPhase.PENDING, req(),
+                                group_name="pg1"))
+        c.add_node(build_node("n1", build_resource_list(cpu="8", memory="8Gi")))
+
+        run_action(c, "allocate")
+        assert not c.binder.binds
+        conds = c.jobs["ns/pg1"].pod_group.status.conditions
+        assert any(cond.reason == "NotEnoughTasks" for cond in conds)
+
+    def test_queue_capacity_multi_tenant(self):
+        # Two queues with weights 3:1 over a 4-cpu cluster: proportion
+        # gives q1 3 cpus deserved, q2 1 cpu.
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=3))
+        c.add_queue(build_queue("q2", weight=1))
+        for g, q, n in (("pg1", "q1", 4), ("pg2", "q2", 4)):
+            c.add_pod_group(build_pod_group(g, namespace="ns", min_member=1,
+                                            queue=q))
+            for i in range(n):
+                c.add_pod(build_pod("ns", f"{g}-p{i}", "", PodPhase.PENDING,
+                                    req(mem="10Mi"), group_name=g))
+        c.add_node(build_node("n1", build_resource_list(cpu="4", memory="8Gi")))
+
+        run_action(c, "allocate")
+        drain(c.binder.channel, 4)
+        q1_binds = sum(1 for k in c.binder.binds if k.startswith("ns/pg1"))
+        q2_binds = sum(1 for k in c.binder.binds if k.startswith("ns/pg2"))
+        assert q1_binds == 3
+        assert q2_binds == 1
+
+
+class TestBackfill:
+    def test_besteffort_pod_backfilled(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=1))
+        c.add_pod(build_pod("ns", "be", "", PodPhase.PENDING, {},
+                            group_name="pg1"))
+        c.add_node(build_node("n1", build_resource_list(cpu="1", memory="1Gi")))
+
+        run_action(c, "backfill")
+        assert drain(c.binder.channel, 1) == ["ns/be"]
+
+
+class TestPreempt:
+    def test_high_priority_job_preempts_within_queue(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        # Low-priority job occupying the whole node.
+        c.add_pod_group(build_pod_group("low", namespace="ns", min_member=1))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+        for i in range(2):
+            c.add_pod(build_pod("ns", f"low-p{i}", "n1", PodPhase.RUNNING,
+                                req(), group_name="low", priority=1))
+        # High-priority starving job.
+        c.add_pod_group(build_pod_group("high", namespace="ns", min_member=1))
+        c.add_pod(build_pod("ns", "high-p0", "", PodPhase.PENDING, req(),
+                            group_name="high", priority=100))
+
+        run_action(c, "preempt")
+        evicts = drain(c.evictor.channel, 1)
+        assert len(evicts) == 1
+        assert evicts[0].startswith("ns/low-p")
+
+    def test_no_preemption_when_gang_would_break(self):
+        # Victim job has minMember == running count: gang protects it.
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("low", namespace="ns", min_member=2))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+        for i in range(2):
+            c.add_pod(build_pod("ns", f"low-p{i}", "n1", PodPhase.RUNNING,
+                                req(), group_name="low", priority=1))
+        c.add_pod_group(build_pod_group("high", namespace="ns", min_member=1))
+        c.add_pod(build_pod("ns", "high-p0", "", PodPhase.PENDING, req(),
+                            group_name="high", priority=100))
+
+        run_action(c, "preempt")
+        assert drain(c.evictor.channel, 1, timeout=0.3) == []
+
+
+class TestReclaim:
+    def test_starving_queue_reclaims_cross_queue(self):
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=1))
+        c.add_queue(build_queue("q2", weight=1))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+        # q1's job running on the whole cluster.
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=1,
+                                        queue="q1"))
+        for i in range(2):
+            c.add_pod(build_pod("ns", f"pg1-p{i}", "n1", PodPhase.RUNNING,
+                                req(), group_name="pg1"))
+        # q2 starving.
+        c.add_pod_group(build_pod_group("pg2", namespace="ns", min_member=1,
+                                        queue="q2"))
+        c.add_pod(build_pod("ns", "pg2-p0", "", PodPhase.PENDING, req(),
+                            group_name="pg2"))
+
+        run_action(c, "reclaim")
+        evicts = drain(c.evictor.channel, 1)
+        assert len(evicts) == 1
+        assert evicts[0].startswith("ns/pg1-p")
+
+
+class TestStatementRollback:
+    def test_discard_restores_state(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+        c.add_pod_group(build_pod_group("low", namespace="ns", min_member=1))
+        c.add_pod(build_pod("ns", "low-p0", "n1", PodPhase.RUNNING, req(),
+                            group_name="low"))
+        c.add_pod_group(build_pod_group("high", namespace="ns", min_member=1))
+        c.add_pod(build_pod("ns", "high-p0", "", PodPhase.PENDING, req(),
+                            group_name="high"))
+
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        ssn = open_session(c, tiers)
+        stmt = ssn.statement()
+        victim = next(
+            t for t in ssn.jobs["ns/low"].tasks.values()
+            if t.status == TaskStatus.RUNNING
+        )
+        claimant = next(iter(ssn.jobs["ns/high"].tasks.values()))
+        node = ssn.nodes["n1"]
+        idle_before = node.idle.milli_cpu
+        releasing_before = node.releasing.milli_cpu
+
+        stmt.evict(victim, "test")
+        stmt.pipeline(claimant, "n1")
+        assert victim.status == TaskStatus.RELEASING
+        assert claimant.status == TaskStatus.PIPELINED
+
+        stmt.discard()
+        assert victim.status == TaskStatus.RUNNING
+        assert claimant.status == TaskStatus.PENDING
+        assert node.idle.milli_cpu == idle_before
+        assert node.releasing.milli_cpu == releasing_before
+        # nothing hit the cache
+        assert not c.evictor.evicts

@@ -1,0 +1,97 @@
+"""Framework unit tests: conf loading, arguments, priority queue, combinators.
+
+Ports reference pkg/scheduler/util_test.go:27 (conf YAML),
+framework/arguments_test.go:30, util/priority_queue semantics.
+"""
+
+import pytest
+
+from kube_batch_tpu.conf import DEFAULT_SCHEDULER_CONF, parse_scheduler_conf
+from kube_batch_tpu.framework import Arguments
+from kube_batch_tpu.scheduler import load_scheduler_conf
+from kube_batch_tpu.utils import PriorityQueue
+
+
+class TestConf:
+    def test_parse_default(self):
+        conf = parse_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        assert conf.actions == "allocate, backfill"
+        assert len(conf.tiers) == 2
+        assert [p.name for p in conf.tiers[0].plugins] == ["priority", "gang"]
+        assert [p.name for p in conf.tiers[1].plugins] == [
+            "drf", "predicates", "proportion", "nodeorder",
+        ]
+        # defaults: everything enabled
+        assert conf.tiers[0].plugins[0].enabled_job_order is True
+
+    def test_disabled_flags(self):
+        conf = parse_scheduler_conf(
+            """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+    jobOrderDisabled: true
+    preemptableDisabled: true
+"""
+        )
+        opt = conf.tiers[0].plugins[0]
+        assert opt.enabled_job_order is False
+        assert opt.enabled_preemptable is False
+        assert opt.enabled_job_ready is True
+
+    def test_arguments_passthrough(self):
+        conf = parse_scheduler_conf(
+            """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: nodeorder
+    arguments:
+      leastrequested.weight: 2
+"""
+        )
+        assert conf.tiers[0].plugins[0].arguments == {"leastrequested.weight": "2"}
+
+    def test_unknown_action_is_hard_error(self):
+        import kube_batch_tpu.actions  # noqa: F401
+
+        with pytest.raises(ValueError):
+            load_scheduler_conf('actions: "nonexistent"\ntiers: []')
+
+    def test_load_actions(self):
+        import kube_batch_tpu.actions  # noqa: F401
+
+        actions, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        assert [a.name() for a in actions] == ["allocate", "backfill"]
+
+
+class TestArguments:
+    def test_get_int(self):
+        args = Arguments({"a": "5", "bad": "x"})
+        assert args.get_int("a", 1) == 5
+        assert args.get_int("bad", 1) == 1
+        assert args.get_int("missing", 7) == 7
+
+    def test_get_bool(self):
+        args = Arguments({"t": "true", "f": "false", "bad": "maybe"})
+        assert args.get_bool("t") is True
+        assert args.get_bool("f") is False
+        assert args.get_bool("bad", True) is True
+
+
+class TestPriorityQueue:
+    def test_orders_by_less_fn(self):
+        q = PriorityQueue(lambda a, b: a < b)
+        for x in (5, 1, 3):
+            q.push(x)
+        assert [q.pop(), q.pop(), q.pop()] == [1, 3, 5]
+
+    def test_stable_on_ties(self):
+        q = PriorityQueue(lambda a, b: a[0] < b[0])
+        q.push((1, "first"))
+        q.push((1, "second"))
+        assert q.pop()[1] == "first"
+
+    def test_pop_empty_returns_none(self):
+        assert PriorityQueue(lambda a, b: a < b).pop() is None
