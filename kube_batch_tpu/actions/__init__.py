@@ -1,7 +1,7 @@
 """Actions (mirrors reference pkg/scheduler/actions).
 
 Importing this package registers every builtin action with the framework
-registry (the reference's factory.go:28-33 / init() pattern). The TPU-native
-allocate_tpu action is registered lazily by kube_batch_tpu.ops import."""
+registry (the reference's factory.go:28-33 / init() pattern), including the
+TPU-native ``allocate_tpu`` batched drop-in."""
 
-from . import allocate, backfill, preempt, reclaim  # noqa: F401
+from . import allocate, allocate_tpu, backfill, preempt, reclaim  # noqa: F401

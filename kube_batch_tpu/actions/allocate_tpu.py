@@ -1,0 +1,119 @@
+"""allocate_tpu: the batched TPU drop-in for the allocate action.
+
+The BASELINE.json north star: a new action, selectable in the scheduler
+policy exactly where ``allocate`` goes, that snapshots the session into
+dense tensors, runs the JAX assignment kernel once, and drives the stock
+``ssn.allocate`` path with the result — so gang gating, event handlers
+(DRF/proportion share updates), dispatch-on-JobReady, and bind side effects
+all behave exactly as in the greedy path (framework/session.go:237-289).
+
+Semantics vs the greedy `allocate` action:
+- identical predicate + resource-fit + epsilon rules (in-kernel);
+- identical scorer formulas (LeastRequested/Balanced recomputed against
+  the evolving idle state, static affinity scores precomputed);
+- queue fair-share budgets enforced per solver round instead of per task;
+- assignments are applied host-side in global priority order, so session
+  bookkeeping matches what the greedy loop would produce for the same
+  assignment set.
+
+Pipelining onto Releasing resources (allocate.go:175-181) is handled in a
+host-side epilogue for tasks the kernel left unassigned.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..framework import Action, register_action
+from ..solver import solve_jit, tensorize
+from ..utils.scheduler_helper import prioritize_nodes, select_best_node
+
+logger = logging.getLogger(__name__)
+
+
+class AllocateTpuAction(Action):
+    def __init__(self, max_rounds: int = 256):
+        self.max_rounds = max_rounds
+
+    def name(self) -> str:
+        return "allocate_tpu"
+
+    def execute(self, ssn) -> None:
+        inputs, ctx = tensorize(ssn)
+        if inputs is None:
+            return
+
+        result = solve_jit(inputs, max_rounds=self.max_rounds)
+        assigned = np.asarray(result.assigned)
+
+        placed = 0
+        # ctx.tasks is already in global priority-rank order.
+        for i in range(len(ctx.tasks)):
+            j = int(assigned[i])
+            if j < 0:
+                continue
+            task, node_name = ctx.tasks[i], ctx.nodes[j].name
+            node = ssn.nodes[node_name]
+            if not task.init_resreq.less_equal(node.idle):
+                # Kernel accounting and session drifted (should not happen);
+                # skip rather than corrupt node bookkeeping.
+                logger.warning(
+                    "solver assignment no longer fits: task %s on %s",
+                    task.uid, node_name,
+                )
+                continue
+            try:
+                ssn.allocate(task, node_name)
+                placed += 1
+            except Exception:
+                logger.exception(
+                    "Failed to bind Task %s on %s", task.uid, node_name
+                )
+
+        # Epilogue: pipeline unassigned tasks onto Releasing resources
+        # (allocate.go:168-181), a host-side pass over the leftovers.
+        # Same gates as greedy: the task must pass predicates on the node
+        # (kernel feas mask), its queue must not be overused
+        # (allocate.go:94-95), and among eligible nodes the best-scored one
+        # wins, mirroring PrioritizeNodes → SelectBestNode.
+        feas = np.asarray(inputs.feas)
+        for i, task in enumerate(ctx.tasks):
+            if int(assigned[i]) >= 0:
+                continue
+            job = ssn.jobs.get(task.job)
+            if job is None:
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is not None and ssn.overused(queue):
+                continue
+            candidates = [
+                ssn.nodes[node.name]
+                for j, node in enumerate(ctx.nodes)
+                if feas[i, j]
+                and task.init_resreq.less_equal(ssn.nodes[node.name].releasing)
+            ]
+            if not candidates:
+                continue
+            priority_list = prioritize_nodes(
+                task, candidates, ssn.node_prioritizers()
+            )
+            best = ssn.nodes[select_best_node(priority_list)]
+            delta = best.idle.clone()
+            delta.fit_delta(task.init_resreq)
+            job.nodes_fit_delta[best.name] = delta
+            try:
+                ssn.pipeline(task, best.name)
+            except Exception:
+                logger.exception(
+                    "Failed to pipeline Task %s on %s", task.uid, best.name
+                )
+
+        logger.debug(
+            "allocate_tpu placed %d/%d tasks in %d rounds",
+            placed, len(ctx.tasks), int(result.rounds),
+        )
+
+
+register_action(AllocateTpuAction())

@@ -61,6 +61,13 @@ class Session:
         self.job_pipelined_fns: Dict[str, Callable] = {}
         self.job_valid_fns: Dict[str, Callable] = {}
         self.node_order_fns: Dict[str, List] = {}
+        # TPU-solver seams: batched [T, N] score builders, per-queue budget
+        # vectors, and weights for the scorers the kernel recomputes per
+        # round (consumed by solver/snapshot.py).
+        self.batch_node_order_fns: Dict[str, List] = {}
+        self.queue_budget_fns: Dict[str, Callable] = {}
+        # plugin name -> {scorer key -> weight} for in-kernel scorers
+        self.solver_score_weights: Dict[str, Dict[str, float]] = {}
 
     # ------------------------------------------------------------------ open
 
@@ -270,6 +277,17 @@ class Session:
         attach a ``batch_fn`` via add_batch_node_order_fn for the TPU path."""
         self.node_order_fns.setdefault(name, []).append((fn, weight))
 
+    def add_batch_node_order_fn(self, name, fn, weight: float = 1.0):
+        """Batched scorer: (tasks, nodes) -> np.ndarray [T, N] of 0..10
+        scores, summed (weighted) into the solver's static score matrix."""
+        self.batch_node_order_fns.setdefault(name, []).append((fn, weight))
+
+    def add_queue_budget_fn(self, name, fn):
+        """Queue budget vectors for the solver: (queue) ->
+        (deserved: Resource, allocated: Resource) or None if the plugin has
+        no opinion (proportion's water-filled shares, proportion.go:100-147)."""
+        self.queue_budget_fns[name] = fn
+
     # ------------------------------------------------- tiered combinators
     # reference framework/session_plugins.go
 
@@ -437,6 +455,64 @@ class Session:
                     continue
                 configs.extend(self.node_order_fns.get(plugin.name, []))
         return configs
+
+    # ------------------------------------------- TPU-solver tier gating
+    # The batched seams honor the same per-tier enable flags as their
+    # scalar counterparts, so allocate and allocate_tpu see identical
+    # policy for a given scheduler conf.
+
+    def batch_predicates(self) -> List:
+        """(name, fn) of enabled batched predicates, tier-gated like
+        predicate_fn."""
+        out: List = []
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_predicate):
+                    continue
+                fn = self.batch_predicate_fns.get(plugin.name)
+                if fn is not None:
+                    out.append((plugin.name, fn))
+        return out
+
+    def scalar_only_predicates(self) -> List:
+        """(name, fn) of enabled scalar predicates that have NO batched
+        form (fallback path for unported plugins)."""
+        out: List = []
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_predicate):
+                    continue
+                if plugin.name in self.batch_predicate_fns:
+                    continue
+                fn = self.predicate_fns.get(plugin.name)
+                if fn is not None:
+                    out.append((plugin.name, fn))
+        return out
+
+    def batch_node_prioritizers(self) -> List:
+        """(fn, weight) of enabled batched scorers, tier-gated like
+        node_prioritizers."""
+        configs: List = []
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_node_order):
+                    continue
+                configs.extend(self.batch_node_order_fns.get(plugin.name, []))
+        return configs
+
+    def solver_dynamic_weights(self) -> Dict[str, float]:
+        """Merged in-kernel scorer weights from plugins whose node-order is
+        enabled (zeroed otherwise, matching node_prioritizers gating)."""
+        merged: Dict[str, float] = {}
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_node_order):
+                    continue
+                for key, w in self.solver_score_weights.get(
+                    plugin.name, {}
+                ).items():
+                    merged[key] = merged.get(key, 0.0) + w
+        return merged
 
     def __repr__(self) -> str:
         return (

@@ -130,5 +130,37 @@ class NodeOrderPlugin(Plugin):
             self._weight(POD_AFFINITY_WEIGHT),
         )
 
+        # TPU solver path: LeastRequested/Balanced depend on the evolving
+        # idle vectors, so the kernel recomputes them in-round from these
+        # weights (keyed by plugin name so tier enablement can gate them);
+        # the affinity scorers are static per session and are delivered as
+        # a batched [T, N] matrix.
+        ssn.solver_score_weights[self.name()] = {
+            "leastrequested": self._weight(LEAST_REQUESTED_WEIGHT),
+            "balancedresource": self._weight(BALANCED_RESOURCE_WEIGHT),
+        }
+
+        import numpy as np
+
+        inter_pod = make_inter_pod_affinity_score(ssn)
+        na_weight = self._weight(NODE_AFFINITY_WEIGHT)
+        pa_weight = self._weight(POD_AFFINITY_WEIGHT)
+
+        def batch_affinity_scores(tasks, nodes):
+            T, N = len(tasks), len(nodes)
+            out = np.zeros((T, N), dtype=np.float32)
+            for i, task in enumerate(tasks):
+                aff = task.pod.spec.affinity
+                if aff is None or not (aff.node_preferred or aff.pod_affinity):
+                    continue
+                for j, node in enumerate(nodes):
+                    out[i, j] = (
+                        node_affinity_score(task, node) * na_weight
+                        + inter_pod(task, node) * pa_weight
+                    )
+            return out
+
+        ssn.add_batch_node_order_fn(self.name(), batch_affinity_scores)
+
 
 register_plugin_builder("nodeorder", lambda args: NodeOrderPlugin(args))

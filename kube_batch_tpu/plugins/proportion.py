@@ -142,6 +142,14 @@ class ProportionPlugin(Plugin):
 
         ssn.add_overused_fn(self.name(), overused_fn)
 
+        def queue_budget_fn(queue: QueueInfo):
+            attr = self.queue_attrs.get(queue.uid)
+            if attr is None:
+                return None
+            return attr.deserved, attr.allocated
+
+        ssn.add_queue_budget_fn(self.name(), queue_budget_fn)
+
         def on_allocate(event):
             job = ssn.jobs[event.task.job]
             attr = self.queue_attrs[job.queue]

@@ -1,0 +1,31 @@
+"""TPU batched-assignment solver.
+
+The genuinely new component of the rebuild (SURVEY.md §7 step 6): the
+reference's per-task greedy allocate loop re-expressed as dense tensor ops —
+feasibility mask, cost matrix, round-based conflict-resolved assignment —
+jitted for TPU, with a sharded multi-chip variant.
+"""
+
+from .kernels import (
+    SolverInputs,
+    SolverResult,
+    dynamic_scores,
+    less_equal,
+    segmented_cumsum,
+    solve,
+    solve_jit,
+)
+from .snapshot import ResourceLayout, SnapshotContext, tensorize
+
+__all__ = [
+    "SolverInputs",
+    "SolverResult",
+    "ResourceLayout",
+    "SnapshotContext",
+    "dynamic_scores",
+    "less_equal",
+    "segmented_cumsum",
+    "solve",
+    "solve_jit",
+    "tensorize",
+]

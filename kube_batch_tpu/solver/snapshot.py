@@ -1,0 +1,270 @@
+"""Session → dense tensors: the snapshot side of the TPU solver.
+
+The reference walks object graphs per task (allocate.go:43-191); here the
+whole Session becomes one `SolverInputs` bundle of arrays (SURVEY.md §7:
+"task-major arrays ... node arrays ... predicates → boolean mask T×N,
+scoring → cost matrix"). Everything host-side is NumPy; the arrays cross to
+device once per solve.
+
+Resource-dimension layout (`ResourceLayout`): dim 0 = milliCPU, dim 1 =
+memory in MiB (scaled from bytes so f32 prefix sums stay far inside the
+10 MiB epsilon, resource_info.go:68-70), dims 2+ = named milli-scalars
+(nvidia.com/gpu, google.com/tpu, ...), the union over every task request and
+node capacity in the session.
+
+Priority ranks reproduce the greedy loop's nested priority-queue order
+statically: queues sorted by ``ssn.queue_order_fn``, jobs within a queue by
+``ssn.job_order_fn``, tasks within a job by ``ssn.task_order_fn``
+(allocate.go:47-117). DRF/proportion shares evolve *during* the greedy loop;
+the batched solver instead re-checks queue budgets every round in-kernel and
+keeps job/task order fixed per solve — same fairness stationary point, one
+documented divergence in intermediate orderings.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import JobInfo, NodeInfo, QueueInfo, Resource, TaskInfo, TaskStatus
+from ..api.resource_info import (
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    MIN_MILLI_SCALAR,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    share as share_fn,
+)
+
+MIB = 2.0**20
+
+
+@dataclass
+class ResourceLayout:
+    """Fixed ordering of resource dimensions for one solve."""
+
+    scalars: List[str] = field(default_factory=list)
+
+    @property
+    def dims(self) -> int:
+        return 2 + len(self.scalars)
+
+    @classmethod
+    def for_session(cls, ssn) -> "ResourceLayout":
+        names = set()
+        for node in ssn.nodes.values():
+            names.update(node.allocatable.scalar_resources or {})
+        for job in ssn.jobs.values():
+            for task in job.tasks.values():
+                names.update(task.resreq.scalar_resources or {})
+                names.update(task.init_resreq.scalar_resources or {})
+        return cls(sorted(names))
+
+    def vec(self, r: Resource) -> np.ndarray:
+        out = np.zeros(self.dims, dtype=np.float32)
+        out[0] = r.milli_cpu
+        out[1] = r.memory / MIB
+        for i, name in enumerate(self.scalars):
+            out[2 + i] = (r.scalar_resources or {}).get(name, 0.0)
+        return out
+
+    def eps(self) -> np.ndarray:
+        out = np.full(self.dims, MIN_MILLI_SCALAR, dtype=np.float32)
+        out[0] = MIN_MILLI_CPU
+        out[1] = MIN_MEMORY / MIB
+        return out
+
+
+@dataclass
+class SnapshotContext:
+    """Maps kernel indices back to session objects."""
+
+    layout: ResourceLayout
+    tasks: List[TaskInfo]
+    nodes: List[NodeInfo]
+    queues: List[QueueInfo]
+
+
+def _sorted_by(items, less_fn):
+    """Sort with a reference-style less-function (returns True iff l
+    schedules before r)."""
+
+    def cmp(l, r):
+        if less_fn(l, r):
+            return -1
+        if less_fn(r, l):
+            return 1
+        return 0
+
+    return sorted(items, key=functools.cmp_to_key(cmp))
+
+
+def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None):
+    """Build `(SolverInputs, SnapshotContext)` for the session's pending,
+    non-best-effort tasks, or ``(None, None)`` if there is nothing to solve.
+
+    ``include_jobs`` restricts the task set (used by tests and by actions
+    that solve for a subset)."""
+    import jax.numpy as jnp
+
+    from .kernels import SolverInputs
+
+    layout = ResourceLayout.for_session(ssn)
+
+    nodes = [n for n in ssn.nodes.values() if n.ready()]
+    if not nodes:
+        return None, None
+
+    # --- ordered task list: queue rank → job rank → task rank -------------
+    queues = [q for q in ssn.queues.values()]
+    queue_order = _sorted_by(queues, ssn.queue_order_fn)
+    queue_index = {q.uid: i for i, q in enumerate(queue_order)}
+
+    jobs_by_queue: Dict[str, List[JobInfo]] = {}
+    job_pool = include_jobs if include_jobs is not None else ssn.jobs.values()
+    for job in job_pool:
+        if job.queue not in ssn.queues:
+            continue
+        jobs_by_queue.setdefault(job.queue, []).append(job)
+
+    # Per-queue task sequences (jobs by job_order_fn, tasks by task_order_fn).
+    queue_sequences: Dict[str, List[TaskInfo]] = {}
+    for q in queue_order:
+        seq: List[TaskInfo] = []
+        for job in _sorted_by(jobs_by_queue.get(q.uid, []), ssn.job_order_fn):
+            pending = list(
+                job.task_status_index.get(TaskStatus.PENDING, {}).values()
+            )
+            for task in _sorted_by(pending, ssn.task_order_fn):
+                if task.resreq.is_empty():
+                    continue  # BestEffort: allocate skips (allocate.go:108)
+                seq.append(task)
+        queue_sequences[q.uid] = seq
+
+    # Global priority ranks via PROGRESSIVE FILLING: the greedy loop pops
+    # the lowest-share queue each turn (queue PQ re-pushed per iteration,
+    # allocate.go:67,191, with proportion's share-based QueueOrderFn).
+    # Ordering every task by the share its queue reaches AFTER its own
+    # allocation reproduces that interleave statically: shares grow
+    # monotonically within a queue, so sorting by (share-after, queue rank,
+    # in-queue position) yields exactly the sequence the dynamic
+    # round-robin would visit when all tasks fit.
+    # Evaluate queue budgets once (first plugin with an opinion wins);
+    # reused for both the progressive-filling ranks and the budget tensors.
+    queue_budgets: Dict[str, Tuple[Resource, Resource]] = {}
+    for q in queue_order:
+        for fn in ssn.queue_budget_fns.values():
+            budget = fn(q)
+            if budget is not None:
+                queue_budgets[q.uid] = budget
+                break
+
+    keyed: List[Tuple[float, int, int, TaskInfo]] = []
+    for q in queue_order:
+        qi = queue_index[q.uid]
+        budget = queue_budgets.get(q.uid)
+        if budget is not None:
+            deserved, allocated = budget
+            cum = allocated.clone()
+        for pos, task in enumerate(queue_sequences[q.uid]):
+            if budget is None:
+                key = 0.0
+            else:
+                cum = cum.clone().add(task.resreq)
+                key = max(
+                    (
+                        share_fn(cum.get(rn), deserved.get(rn))
+                        for rn in deserved.resource_names()
+                    ),
+                    default=0.0,
+                )
+            keyed.append((key, qi, pos, task))
+    keyed.sort(key=lambda e: (e[0], e[1], e[2]))
+
+    tasks = [e[3] for e in keyed]
+    task_queue_ids = [e[1] for e in keyed]
+    if not tasks:
+        return None, None
+
+    T, N, R = len(tasks), len(nodes), layout.dims
+
+    task_req = np.stack([layout.vec(t.resreq) for t in tasks])
+    task_fit = np.stack([layout.vec(t.init_resreq) for t in tasks])
+    task_rank = np.arange(T, dtype=np.int32)
+    task_queue = np.asarray(task_queue_ids, dtype=np.int32)
+    job_dense: Dict[str, int] = {}
+    task_job = np.asarray(
+        [job_dense.setdefault(t.job, len(job_dense)) for t in tasks],
+        dtype=np.int32,
+    )
+
+    node_idle = np.stack([layout.vec(n.idle) for n in nodes])
+    node_releasing = np.stack([layout.vec(n.releasing) for n in nodes])
+    node_cap = np.stack([layout.vec(n.allocatable) for n in nodes])
+    node_task_count = np.asarray(
+        [len(n.tasks) for n in nodes], dtype=np.int32
+    )
+    node_max_tasks = np.asarray(
+        [n.allocatable.max_task_num for n in nodes], dtype=np.int32
+    )
+
+    # --- predicates → bool mask (tier-gated like Session.predicate_fn) ----
+    feas = np.ones((T, N), dtype=bool)
+    for name, fn in ssn.batch_predicates():
+        feas &= np.asarray(fn(tasks, nodes), dtype=bool)
+    # Scalar-only predicate plugins (no batched form) fall back to the
+    # per-pair path so correctness never depends on a plugin being ported.
+    for name, fn in ssn.scalar_only_predicates():
+        for i, task in enumerate(tasks):
+            for j, node in enumerate(nodes):
+                if not feas[i, j]:
+                    continue
+                try:
+                    fn(task, node)
+                except Exception:
+                    feas[i, j] = False
+
+    # --- static score matrix (tier-gated like node_prioritizers) ----------
+    static_score = np.zeros((T, N), dtype=np.float32)
+    for fn, weight in ssn.batch_node_prioritizers():
+        static_score += weight * np.asarray(fn(tasks, nodes), np.float32)
+
+    # --- queue budget vectors ---------------------------------------------
+    Qn = max(1, len(queue_order))
+    queue_deserved = np.full((Qn, R), np.inf, dtype=np.float32)
+    queue_allocated = np.zeros((Qn, R), dtype=np.float32)
+    for q in queue_order:
+        budget = queue_budgets.get(q.uid)
+        if budget is None:
+            continue
+        deserved, allocated = budget
+        queue_deserved[queue_index[q.uid]] = layout.vec(deserved)
+        queue_allocated[queue_index[q.uid]] = layout.vec(allocated)
+
+    weights = ssn.solver_dynamic_weights()
+    inputs = SolverInputs(
+        task_req=jnp.asarray(task_req),
+        task_fit=jnp.asarray(task_fit),
+        task_rank=jnp.asarray(task_rank),
+        task_job=jnp.asarray(task_job),
+        task_queue=jnp.asarray(task_queue),
+        feas=jnp.asarray(feas),
+        static_score=jnp.asarray(static_score),
+        node_idle=jnp.asarray(node_idle),
+        node_releasing=jnp.asarray(node_releasing),
+        node_cap=jnp.asarray(node_cap),
+        node_task_count=jnp.asarray(node_task_count),
+        node_max_tasks=jnp.asarray(node_max_tasks),
+        queue_deserved=jnp.asarray(queue_deserved),
+        queue_allocated=jnp.asarray(queue_allocated),
+        eps=jnp.asarray(layout.eps()),
+        lr_weight=jnp.asarray(weights.get("leastrequested", 0.0), jnp.float32),
+        br_weight=jnp.asarray(
+            weights.get("balancedresource", 0.0), jnp.float32
+        ),
+    )
+    ctx = SnapshotContext(layout, tasks, nodes, queue_order)
+    return inputs, ctx
