@@ -197,13 +197,39 @@ class PredicatesPlugin(Plugin):
         def batch_predicate_fn(
             tasks: List[TaskInfo], nodes: List[NodeInfo]
         ) -> np.ndarray:
-            """[T, N] bool mask of the static (non-pod-affinity) predicates,
-            vectorized per node column. Pod-affinity terms fall back to the
-            scalar path for the few tasks that carry them."""
+            """[T, N] bool mask of the static (non-pod-affinity) predicates.
+
+            Node-level checks are evaluated once per node column. Per-pair
+            checks run ONLY for tasks that actually carry a selector,
+            affinity, host ports, or live next to node taints — the common
+            case (plain resource-only pods) costs O(N), not O(T*N), which
+            is what keeps host-side snapshotting off the critical path at
+            50k tasks x 5k nodes."""
             T, N = len(tasks), len(nodes)
             mask = np.ones((T, N), dtype=bool)
+
+            # Tasks needing per-pair evaluation, by reason.
+            def needs_pair_check(task: TaskInfo) -> bool:
+                spec = task.pod.spec
+                aff = spec.affinity
+                return bool(
+                    spec.node_selector
+                    or any(c.ports for c in spec.containers)
+                    or (
+                        aff is not None
+                        and (
+                            aff.node_required
+                            or aff.pod_affinity
+                            or aff.pod_anti_affinity
+                        )
+                    )
+                )
+
+            pair_tasks = [
+                (i, t) for i, t in enumerate(tasks) if needs_pair_check(t)
+            ]
+
             for j, node in enumerate(nodes):
-                node_ok = True
                 try:
                     check_node_condition(tasks[0] if tasks else None, node)
                     check_node_unschedulable(None, node)
@@ -214,21 +240,27 @@ class PredicatesPlugin(Plugin):
                     if pid_enable:
                         _check_pressure(node, "PIDPressure", "x")
                 except PredicateError:
-                    node_ok = False
-                if not node_ok:
                     mask[:, j] = False
                     continue
-                full = (
-                    0 < node.allocatable.max_task_num <= len(node.tasks)
-                )
-                if full:
+                if 0 < node.allocatable.max_task_num <= len(node.tasks):
                     mask[:, j] = False
                     continue
-                for i, task in enumerate(tasks):
+
+                # Taints apply to every task (tolerations vary per task);
+                # nodes without taints skip the column entirely.
+                if node.node is not None and node.node.spec.taints:
+                    for i, task in enumerate(tasks):
+                        try:
+                            pod_tolerates_node_taints(task, node)
+                        except PredicateError:
+                            mask[i, j] = False
+
+                for i, task in pair_tasks:
+                    if not mask[i, j]:
+                        continue
                     try:
                         pod_match_node_selector(task, node)
                         pod_fits_host_ports(task, node)
-                        pod_tolerates_node_taints(task, node)
                         aff = task.pod.spec.affinity
                         if aff is not None and (
                             aff.pod_affinity or aff.pod_anti_affinity

@@ -122,6 +122,32 @@ def segmented_cumsum(x: jnp.ndarray, is_start: jnp.ndarray) -> jnp.ndarray:
     return vals
 
 
+def _hash01(i: jnp.ndarray, salt: int) -> jnp.ndarray:
+    """Deterministic [0, 1) hash of int32 indices (Knuth multiplicative)."""
+    x = (i.astype(jnp.uint32) + jnp.uint32(salt)) * jnp.uint32(2654435761)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(2246822519)
+    return (x >> 8).astype(jnp.float32) / jnp.float32(1 << 24)
+
+
+def tie_jitter(T: int, N: int, scale: float = 1e-4) -> jnp.ndarray:
+    """Sub-epsilon score jitter breaking argmax ties.
+
+    Greedy picks RANDOMLY among equal-scored nodes
+    (scheduler_helper.go:188-208). Batched argmax without jitter herds every
+    equal-scored task onto the lowest-index node, so only one node fills per
+    round. ``frac(u[t] + v[n])`` gives each task a different preferred
+    position in the node ordering (the wrap point shifts with u[t]) from two
+    O(T)+O(N) hash vectors — XLA fuses the outer sum into the score compute,
+    so no [T, N] jitter tensor ever hits HBM. scale=1e-4 is far below any
+    real score gap (one 250m-CPU delta on a 32-CPU node moves LeastRequested
+    by ~4e-2), so a genuine preference is never overridden."""
+    u = _hash01(jnp.arange(T, dtype=jnp.int32), 0x5EED)
+    v = _hash01(jnp.arange(N, dtype=jnp.int32), 0xBEEF)
+    s = u[:, None] + v[None, :]
+    return scale * (s - jnp.floor(s))
+
+
 def dynamic_scores(
     task_req: jnp.ndarray,
     node_idle: jnp.ndarray,
@@ -248,6 +274,7 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
                 inputs.lr_weight, inputs.br_weight,
             )
             + inputs.static_score
+            + tie_jitter(T, N)
         )
         score = jnp.where(mask, score, -jnp.inf)
         bid = jnp.argmax(score, axis=1).astype(jnp.int32)         # [T]

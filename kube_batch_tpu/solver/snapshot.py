@@ -231,6 +231,8 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None):
     static_score = np.zeros((T, N), dtype=np.float32)
     for fn, weight in ssn.batch_node_prioritizers():
         static_score += weight * np.asarray(fn(tasks, nodes), np.float32)
+    # Tie-break jitter is applied in-kernel (kernels.py tie_jitter): fused
+    # hash vectors, no host-side [T, N] materialization.
 
     # --- queue budget vectors ---------------------------------------------
     Qn = max(1, len(queue_order))
