@@ -1,0 +1,6 @@
+"""``python -m kube_batch_tpu`` — the scheduler binary."""
+
+from .cli import main
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,32 @@ class ReclaimAction(Action):
                 continue
             task = tasks.pop()
 
+            # Deliberate divergence from reclaim.go: skip eviction when the
+            # claimant already fits free (idle or releasing) capacity on a
+            # feasible node — allocate, which runs after reclaim in the
+            # default policy, will place it this same cycle. The reference
+            # lacks this guard and relies on slow real-cluster pod deletion
+            # to not over-evict; with an instant substrate it would drain
+            # the victim queue far below its deserved share (contradicting
+            # its own e2e contract, test/e2e/queue.go:26-69).
+            fits_free = False
+            for node in get_node_list(ssn.nodes):
+                try:
+                    ssn.predicate_fn(task, node)
+                except Exception:
+                    continue
+                # Match allocate's placement test exactly (fits Idle → bind,
+                # else fits Releasing → pipeline); idle+releasing summed
+                # would skip eviction for a task allocate cannot place.
+                if task.init_resreq.less_equal(node.idle) or (
+                    task.init_resreq.less_equal(node.releasing)
+                ):
+                    fits_free = True
+                    break
+            if fits_free:
+                queues.push(queue)
+                continue
+
             assigned = False
             for node in get_node_list(ssn.nodes):
                 try:

@@ -26,7 +26,7 @@ from .resource_info import ResourceList
 GROUP_NAME_ANNOTATION_KEY = "scheduling.k8s.io/group-name"
 
 # Default scheduler name (reference: cmd/kube-batch/app/options/options.go:62).
-DEFAULT_SCHEDULER_NAME = "kube-batch"
+DEFAULT_SCHEDULER_NAME = "tpu-batch"
 
 _uid_counter = itertools.count(1)
 

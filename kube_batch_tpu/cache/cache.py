@@ -39,6 +39,7 @@ from ..api import (
     TaskInfo,
     TaskStatus,
 )
+from ..api.objects import DEFAULT_SCHEDULER_NAME
 from ..cluster import ADDED, DELETED, MODIFIED, ClusterAPI
 from .event_handlers import EventHandlersMixin
 from .interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
@@ -93,7 +94,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
     def __init__(
         self,
         cluster: Optional[ClusterAPI] = None,
-        scheduler_name: str = "kube-batch",
+        scheduler_name: str = DEFAULT_SCHEDULER_NAME,
         default_queue: str = "default",
         binder: Optional[Binder] = None,
         evictor: Optional[Evictor] = None,
@@ -132,8 +133,39 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self._executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="cache-sideeffect"
         )
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
         self._synced = cluster is None
         self._stop = threading.Event()
+
+    def _submit_side_effect(self, fn) -> None:
+        """Run a bind/evict side effect on the async pool, tracking it so
+        tests/benchmarks can barrier on completion (the reference's
+        equivalent is draining the fake binder channel with a timeout,
+        allocate_test.go:199-209)."""
+        with self._inflight_cond:
+            self._inflight += 1
+
+        def wrapped():
+            try:
+                fn()
+            finally:
+                with self._inflight_cond:
+                    self._inflight -= 1
+                    self._inflight_cond.notify_all()
+
+        self._executor.submit(wrapped)
+
+    def wait_for_side_effects(self, timeout: float = 10.0) -> bool:
+        """Block until every queued async bind/evict has executed."""
+        deadline = time.time() + timeout
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._inflight_cond.wait(remaining)
+        return True
 
     # -- watch ingest (informer analog) -------------------------------------
 
@@ -300,7 +332,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 self._resync_task(task_snapshot)
 
         if self.binder is not None:
-            self._executor.submit(_do_bind)
+            self._submit_side_effect(_do_bind)
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         """reference cache.go:421-477"""
@@ -328,7 +360,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 self._resync_task(task_snapshot)
 
         if self.evictor is not None:
-            self._executor.submit(_do_evict)
+            self._submit_side_effect(_do_evict)
 
     # -- volumes -------------------------------------------------------------
 

@@ -1,0 +1,259 @@
+"""Server runner: metrics endpoint, leader election, scheduler lifecycle.
+
+Mirrors reference cmd/kube-batch/app/server.go (:63 Run — build config,
+start scheduler, /metrics HTTP server :86-89, leader election via resource
+lock :96-141). Standalone substitutions: the cluster substrate is the
+in-process store (or a YAML-loaded snapshot of one), and the leader lock is
+a lease file in the lock namespace directory — same lease/renew/retry
+timings as the reference's ConfigMap lock (server.go:49-53).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from .. import metrics
+from ..cache import new_scheduler_cache
+from ..cluster import ClusterAPI, InProcessCluster
+from ..scheduler import Scheduler
+from .options import (
+    LEASE_DURATION,
+    RENEW_DEADLINE,
+    RETRY_PERIOD,
+    ServerOption,
+    register_options,
+)
+from .state import load_cluster_state
+
+logger = logging.getLogger(__name__)
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    """Serves /metrics in Prometheus text exposition format plus /healthz
+    (reference server.go:86-89 promhttp handler)."""
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path.rstrip("/") in ("", "/healthz"):
+            body = b"ok\n"
+            ctype = "text/plain"
+        elif self.path.startswith("/metrics"):
+            body = metrics.REGISTRY.expose_text().encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        logger.debug("metrics-http: " + fmt, *args)
+
+
+def start_metrics_server(listen_address: str) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Start the /metrics endpoint in a daemon thread; returns (server, thread)."""
+    host, _, port = listen_address.rpartition(":")
+    server = ThreadingHTTPServer((host or "0.0.0.0", int(port)), _MetricsHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="metrics-http")
+    thread.start()
+    return server, thread
+
+
+class LeaderElector:
+    """File-lease leader election.
+
+    The reference locks a ConfigMap via resourcelock + leaderelection
+    (server.go:96-141, lease 15s / renew 10s / retry 5s). Standalone analog:
+    an O_EXCL-created lease file carrying {holder, renew_ts}; a lease whose
+    renew timestamp is older than the lease duration may be stolen. Same
+    timings, same semantics: winner runs, loser retries; losing the lease
+    mid-flight calls on_stopped_leading (the reference fatals there,
+    server.go:133).
+    """
+
+    def __init__(
+        self,
+        lock_dir: str,
+        identity: str,
+        lease_duration: float = LEASE_DURATION,
+        renew_deadline: float = RENEW_DEADLINE,
+        retry_period: float = RETRY_PERIOD,
+    ):
+        self.lock_path = os.path.join(lock_dir, "tpu-batch-leader.lock")
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self._renew_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.is_leader = False
+
+    def _read_lease(self):
+        try:
+            with open(self.lock_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write_lease(self) -> None:
+        tmp = f"{self.lock_path}.{self.identity}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"holder": self.identity, "renew_ts": time.time()}, f)
+        os.replace(tmp, self.lock_path)
+
+    def try_acquire(self) -> bool:
+        lease = self._read_lease()
+        now = time.time()
+        if lease is not None and lease["holder"] == self.identity:
+            # Renewal of our own lease: os.replace over a file we hold.
+            self._write_lease()
+            lease = self._read_lease()
+            self.is_leader = bool(lease and lease["holder"] == self.identity)
+            return self.is_leader
+        if lease is not None and now - lease["renew_ts"] <= self.lease_duration:
+            self.is_leader = False
+            return False
+        # Lease absent or stale. Claim a stale lease by renaming it to a
+        # per-identity tomb (atomic — exactly one claimer succeeds; the
+        # loser's rename raises FileNotFoundError and it must win the O_EXCL
+        # create below instead), then contend on exclusive creation so two
+        # starters can never both see themselves as holder.
+        if lease is not None:
+            tomb = f"{self.lock_path}.{self.identity}.stale"
+            try:
+                os.rename(self.lock_path, tomb)
+            except OSError:
+                pass
+            else:
+                try:
+                    os.remove(tomb)
+                except OSError:
+                    pass
+        try:
+            fd = os.open(
+                self.lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644
+            )
+        except FileExistsError:
+            self.is_leader = False
+            return False
+        with os.fdopen(fd, "w") as f:
+            json.dump({"holder": self.identity, "renew_ts": time.time()}, f)
+        self.is_leader = True
+        return True
+
+    def run(self, on_started_leading, on_stopped_leading) -> None:
+        """Block until leadership is acquired, then run the payload while
+        renewing every retry_period (reference leaderelection.RunOrDie)."""
+        while not self._stop.is_set() and not self.try_acquire():
+            logger.info("leader election: lease held by another instance; retrying")
+            self._stop.wait(self.retry_period)
+        if self._stop.is_set():
+            return
+
+        lost = threading.Event()
+
+        def renew_loop():
+            last_renew = time.time()
+            while not self._stop.is_set() and not lost.is_set():
+                if self.try_acquire():
+                    last_renew = time.time()
+                elif time.time() - last_renew > self.renew_deadline:
+                    lost.set()
+                    break
+                self._stop.wait(self.retry_period)
+
+        self._renew_thread = threading.Thread(
+            target=renew_loop, daemon=True, name="leader-renew"
+        )
+        self._renew_thread.start()
+        try:
+            on_started_leading(lost)
+        finally:
+            if lost.is_set():
+                self.is_leader = False
+                on_stopped_leading()
+
+    def release(self) -> None:
+        self._stop.set()
+        lease = self._read_lease()
+        if lease and lease["holder"] == self.identity:
+            try:
+                os.remove(self.lock_path)
+            except OSError:
+                pass
+
+
+def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
+        stop_event: Optional[threading.Event] = None) -> None:
+    """reference app/server.go:63-141 Run."""
+    register_options(opt)
+    if cluster is None:
+        if opt.cluster_state:
+            cluster = load_cluster_state(
+                opt.cluster_state, simulate_kubelet=opt.simulate_kubelet
+            )
+        else:
+            cluster = InProcessCluster(simulate_kubelet=opt.simulate_kubelet)
+
+    cache = new_scheduler_cache(
+        cluster, opt.scheduler_name, opt.default_queue,
+        enable_priority_class=opt.enable_priority_class,
+    )
+    sched = Scheduler(
+        cache,
+        scheduler_conf=opt.scheduler_conf or None,
+        schedule_period=opt.schedule_period,
+    )
+
+    http_server, _ = start_metrics_server(opt.listen_address)
+    stop = stop_event or threading.Event()
+
+    def run_scheduler(lost_leadership: Optional[threading.Event] = None):
+        if opt.once:
+            cache.run(stop)
+            cache.wait_for_cache_sync(stop)
+            sched.run_once()
+            # Binds/evicts execute on the cache's async pool; barrier so
+            # callers observe the fully-applied schedule after run().
+            cache.wait_for_side_effects()
+            return
+        if lost_leadership is not None:
+            # Chain: leadership loss stops the scheduling loop.
+            def watch():
+                lost_leadership.wait()
+                stop.set()
+            threading.Thread(target=watch, daemon=True).start()
+        sched.run(stop)
+
+    try:
+        if not opt.enable_leader_election:
+            run_scheduler()
+            return
+
+        opt.check_option_or_die()
+        elector = LeaderElector(
+            opt.lock_object_namespace,
+            identity=f"{os.uname().nodename}-{os.getpid()}",
+        )
+        try:
+            elector.run(
+                on_started_leading=run_scheduler,
+                on_stopped_leading=lambda: logger.error(
+                    "lost leadership; stopping scheduling loop"
+                ),
+            )
+        finally:
+            elector.release()
+    finally:
+        stop.set()
+        http_server.shutdown()

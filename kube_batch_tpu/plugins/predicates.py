@@ -29,6 +29,7 @@ import numpy as np
 from ..api import NodeInfo, TaskInfo
 from ..framework import Plugin, register_plugin_builder
 from .util import (
+    PLACED_STATUSES,
     PredicateError,
     SessionPodLister,
     match_label_selector,
@@ -160,10 +161,23 @@ class PredicatesPlugin(Plugin):
                     match_label_selector(sel, t.pod.metadata.labels)
                     for t in on_node
                 ):
-                    raise PredicateError(
-                        "MatchInterPodAffinity",
-                        f"pod affinity not satisfied on {node.name}",
+                    # k8s bootstrap rule (vendored predicates
+                    # satisfiesPodsAffinityAntiAffinity): a required term with
+                    # NO matching pod anywhere is satisfied if the incoming
+                    # pod itself matches the selector — the first pod of a
+                    # self-affine group must be schedulable somewhere.
+                    exists_anywhere = any(
+                        match_label_selector(sel, t.pod.metadata.labels)
+                        for t in lister.tasks()
+                        if t.uid != task.uid and t.status in PLACED_STATUSES
                     )
+                    if exists_anywhere or not match_label_selector(
+                        sel, task.pod.metadata.labels
+                    ):
+                        raise PredicateError(
+                            "MatchInterPodAffinity",
+                            f"pod affinity not satisfied on {node.name}",
+                        )
             for term in affinity.pod_anti_affinity or []:
                 sel = term.get("label_selector", {})
                 if any(

@@ -21,6 +21,18 @@ class PredicateError(Exception):
         super().__init__(self.message)
 
 
+# Statuses that make a task "present" for (anti-)affinity evaluation: on a
+# node now or headed there this session (includes PIPELINED, unlike
+# api.allocated_status — a pipelined group-mate must anchor affinity).
+PLACED_STATUSES = (
+    TaskStatus.RUNNING,
+    TaskStatus.ALLOCATED,
+    TaskStatus.PIPELINED,
+    TaskStatus.BINDING,
+    TaskStatus.BOUND,
+)
+
+
 class SessionPodLister:
     """Lists session pods with the session's current node assignment
     (reference plugins/util/util.go:31-85: pods whose task moved in-session
@@ -38,13 +50,7 @@ class SessionPodLister:
     def pods_on_node(self, node_name: str) -> List[TaskInfo]:
         out = []
         for task in self.tasks():
-            if task.node_name == node_name and task.status in (
-                TaskStatus.RUNNING,
-                TaskStatus.ALLOCATED,
-                TaskStatus.PIPELINED,
-                TaskStatus.BINDING,
-                TaskStatus.BOUND,
-            ):
+            if task.node_name == node_name and task.status in PLACED_STATUSES:
                 out.append(task)
         return out
 

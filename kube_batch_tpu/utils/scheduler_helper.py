@@ -56,13 +56,18 @@ def sort_nodes(
     return [nodes_info[name] for name, _ in ordered]
 
 
+# Module-scoped RNG so tests can pin tie-breaking without mutating the
+# process-wide stdlib random state.
+_rng = random.Random()
+
+
 def select_best_node(priority_list: HostPriorityList) -> str:
     """Highest score, random among ties (scheduler_helper.go:188-208)."""
     if not priority_list:
         raise ValueError("empty priority list")
     max_score = max(s for _, s in priority_list)
     best = [name for name, s in priority_list if s == max_score]
-    return random.choice(best)
+    return _rng.choice(best)
 
 
 def get_node_list(nodes: Dict[str, NodeInfo]) -> List[NodeInfo]:

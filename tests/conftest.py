@@ -7,7 +7,25 @@ multi-chip path via __graft_entry__.dryrun_multichip).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment preselects a TPU platform (e.g.
+# JAX_PLATFORMS=axon): unit/e2e tests must be hardware-independent; the
+# benchmark harness and the driver's dryrun use the real platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# A site-injected PJRT plugin (tunneled TPU) may already be registered by
+# sitecustomize before this conftest runs; jax initializes every registered
+# factory during backend discovery, so JAX_PLATFORMS=cpu alone does not stop
+# it from dialing the (possibly unreachable) tunnel and hanging the whole
+# test run. Drop every non-CPU factory before the first backend resolution.
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+for _name in [n for n in _xb._backend_factories if n != "cpu"]:
+    del _xb._backend_factories[_name]
+
+# sitecustomize may have imported jax at interpreter start, freezing the
+# platform config from the pre-override environment; update it explicitly.
+jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

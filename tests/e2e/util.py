@@ -1,0 +1,216 @@
+"""E2E helpers (reference test/e2e/util.go).
+
+A ``Context`` runs the REAL ``Scheduler`` loop in a daemon thread against an
+``InProcessCluster`` with the hollow-kubelet simulation on (the kubemark
+analog): binds flip pods to Running, evictions delete pods. Jobs are
+created as PodGroup + pods like ``createJob`` (util.go:300); waiters poll
+phases like ``waitPodGroupReady``/``waitTasksReady`` (util.go:462-488).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kube_batch_tpu.api import PodPhase, PriorityClass, build_resource_list
+from kube_batch_tpu.api.objects import ObjectMeta
+from kube_batch_tpu.cache import new_scheduler_cache
+from kube_batch_tpu.cluster import InProcessCluster
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.utils.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+ONE_CPU = build_resource_list(cpu="1000m", memory="1Gi")
+HALF_CPU = build_resource_list(cpu="500m", memory="512Mi")
+
+DEFAULT_CONF = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+PREEMPT_CONF = """
+actions: "allocate, backfill, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+RECLAIM_CONF = """
+actions: "reclaim, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+@dataclass
+class JobSpec:
+    """reference test/e2e/util.go taskSpec/jobSpec (simplified to pods)."""
+
+    name: str
+    namespace: str = "test"
+    queue: str = "default"
+    replicas: int = 1
+    min_member: Optional[int] = None  # default: replicas
+    req: Dict = field(default_factory=lambda: dict(ONE_CPU))
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    labels: Optional[Dict[str, str]] = None
+    selector: Optional[Dict[str, str]] = None
+
+
+class Context:
+    """reference test/e2e/util.go:100 initTestContext (standalone)."""
+
+    def __init__(
+        self,
+        nodes: int = 2,
+        node_cpu: str = "4",
+        node_mem: str = "8Gi",
+        queues: Optional[Dict[str, int]] = None,
+        conf: str = DEFAULT_CONF,
+        period: float = 0.02,
+    ):
+        self.cluster = InProcessCluster(simulate_kubelet=True)
+        for name, weight in (queues or {"default": 1}).items():
+            self.cluster.create_queue(build_queue(name, weight=weight))
+        self.nodes = []
+        for i in range(nodes):
+            node = build_node(
+                f"node-{i}",
+                build_resource_list(cpu=node_cpu, memory=node_mem, pods=110),
+            )
+            self.nodes.append(node)
+            self.cluster.create_node(node)
+        self.cache = new_scheduler_cache(self.cluster, "tpu-batch", "default")
+        self.scheduler = Scheduler(
+            self.cache, scheduler_conf=conf, schedule_period=period
+        )
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self.scheduler.run, args=(self.stop,), daemon=True
+        )
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop.set()
+        self.thread.join(timeout=10)
+
+    # -- object creation ----------------------------------------------------
+
+    def create_priority_class(self, name: str, value: int) -> None:
+        self.cluster.create_priority_class(
+            PriorityClass(metadata=ObjectMeta(name=name), value=value)
+        )
+
+    def create_job(self, spec: JobSpec) -> List:
+        """reference util.go:300 createJob: PodGroup + replica pods."""
+        min_member = spec.min_member if spec.min_member is not None else spec.replicas
+        self.cluster.create_pod_group(build_pod_group(
+            spec.name, namespace=spec.namespace, min_member=min_member,
+            queue=spec.queue, priority_class_name=spec.priority_class_name,
+        ))
+        pods = []
+        for i in range(spec.replicas):
+            pod = build_pod(
+                spec.namespace, f"{spec.name}-{i}", "", PodPhase.PENDING,
+                dict(spec.req), group_name=spec.name, labels=spec.labels,
+                selector=spec.selector, priority=spec.priority,
+            )
+            pods.append(pod)
+        # Pods may be customized by the caller before creation.
+        return pods
+
+    def submit(self, pods: List) -> None:
+        for pod in pods:
+            self.cluster.create_pod(pod)
+
+    def create_and_submit(self, spec: JobSpec) -> List:
+        pods = self.create_job(spec)
+        self.submit(pods)
+        return pods
+
+    # -- waiters (reference util.go:462-488) --------------------------------
+
+    def _await(self, fn, timeout: float = 10.0, interval: float = 0.02) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if fn():
+                return True
+            time.sleep(interval)
+        return fn()
+
+    def pods(self, namespace: str = "test") -> List:
+        return [
+            p for p in self.cluster.list_objects("Pod")
+            if p.namespace == namespace
+        ]
+
+    def running_pods(self, job: str, namespace: str = "test") -> List:
+        return [
+            p for p in self.pods(namespace)
+            if p.name.startswith(f"{job}-") and p.status.phase == PodPhase.RUNNING
+        ]
+
+    def wait_tasks_ready(self, job: str, n: int, namespace: str = "test",
+                         timeout: float = 10.0) -> bool:
+        """reference util.go waitTasksReady: ≥n pods of the job Running."""
+        return self._await(
+            lambda: len(self.running_pods(job, namespace)) >= n, timeout
+        )
+
+    def wait_job_gone(self, job: str, namespace: str = "test",
+                      timeout: float = 10.0) -> bool:
+        return self._await(
+            lambda: not [
+                p for p in self.pods(namespace)
+                if p.name.startswith(f"{job}-")
+            ],
+            timeout,
+        )
+
+    def wait_pod_group_phase(self, name: str, phase: str,
+                             namespace: str = "test",
+                             timeout: float = 10.0) -> bool:
+        def check():
+            for pg in self.cluster.list_objects("PodGroup"):
+                if pg.name == name and pg.namespace == namespace:
+                    return pg.status.phase == phase
+            return False
+        return self._await(check, timeout)
+
+    def settle(self, cycles: float = 5.0) -> None:
+        """Let the scheduler run a few cycles (for negative assertions)."""
+        time.sleep(self.scheduler.schedule_period * cycles + 0.1)

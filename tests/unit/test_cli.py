@@ -1,0 +1,163 @@
+"""CLI/process layer tests (reference cmd/kube-batch/app).
+
+Covers flag parsing (options.go), the /metrics HTTP endpoint
+(server.go:86-89), file-lease leader election (server.go:96-141 analog),
+the cluster-state loader, and a full end-to-end --once run through
+``cli.run`` binding a gang onto the in-process cluster.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu.cli import (
+    LeaderElector,
+    ServerOption,
+    build_cluster_from_dict,
+    load_cluster_state,
+    parse_options,
+    run,
+    start_metrics_server,
+)
+from kube_batch_tpu.version import version_string
+
+EXAMPLE_STATE = {
+    "queues": [{"name": "default", "weight": 1}],
+    "nodes": [
+        {"name": "n1", "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}},
+        {"name": "n2", "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}},
+    ],
+    "podGroups": [
+        {"name": "pg1", "namespace": "default", "minMember": 3, "queue": "default"}
+    ],
+    "pods": [
+        {"name": f"p{i}", "namespace": "default", "group": "pg1",
+         "requests": {"cpu": "1000m", "memory": "1Gi"}}
+        for i in range(3)
+    ],
+}
+
+
+def test_parse_options_defaults():
+    opt = parse_options([])
+    assert opt.scheduler_name == "tpu-batch"
+    assert opt.schedule_period == 1.0
+    assert opt.default_queue == "default"
+    assert opt.listen_address == ":8080"
+    assert opt.enable_priority_class
+    assert not opt.enable_leader_election
+
+
+def test_parse_options_flags():
+    opt = parse_options([
+        "--scheduler-name", "x", "--schedule-period", "0.25",
+        "--default-queue", "q", "--leader-elect",
+        "--lock-object-namespace", "/tmp/locks", "--no-priority-class",
+        "--once",
+    ])
+    assert opt.scheduler_name == "x"
+    assert opt.schedule_period == 0.25
+    assert opt.default_queue == "q"
+    assert opt.enable_leader_election
+    assert opt.lock_object_namespace == "/tmp/locks"
+    assert not opt.enable_priority_class
+    assert opt.once
+
+
+def test_check_option_or_die():
+    opt = ServerOption(enable_leader_election=True, lock_object_namespace="")
+    with pytest.raises(ValueError):
+        opt.check_option_or_die()
+
+
+def test_version_string():
+    s = version_string()
+    assert "tpu-batch version" in s
+
+
+def test_cluster_state_loader(tmp_path):
+    import yaml
+
+    path = tmp_path / "state.yaml"
+    path.write_text(yaml.safe_dump(EXAMPLE_STATE))
+    cluster = load_cluster_state(str(path))
+    assert len(cluster.list_objects("Node")) == 2
+    assert len(cluster.list_objects("Pod")) == 3
+    assert len(cluster.list_objects("PodGroup")) == 1
+    assert len(cluster.list_objects("Queue")) == 1
+
+
+def test_metrics_http_endpoint():
+    server, _ = start_metrics_server("127.0.0.1:0")
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "tpu_batch_e2e_scheduling_latency_seconds" in body
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ).read()
+        assert health == b"ok\n"
+    finally:
+        server.shutdown()
+
+
+def test_leader_election_exclusive(tmp_path):
+    a = LeaderElector(str(tmp_path), "a", lease_duration=5.0)
+    b = LeaderElector(str(tmp_path), "b", lease_duration=5.0)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    # Stale lease (older than lease_duration) may be stolen.
+    with open(a.lock_path) as f:
+        lease = json.load(f)
+    lease["renew_ts"] = time.time() - 10.0
+    with open(a.lock_path, "w") as f:
+        json.dump(lease, f)
+    assert b.try_acquire()
+    assert not a.try_acquire()
+    b.release()
+    assert not os.path.exists(b.lock_path)
+
+
+def test_run_once_binds_gang():
+    """Full process path: cli.run --once schedules the example gang."""
+    cluster = build_cluster_from_dict(EXAMPLE_STATE)
+    opt = ServerOption(
+        enable_leader_election=False, once=True,
+        listen_address="127.0.0.1:0",
+    )
+    run(opt, cluster=cluster)
+    pods = cluster.list_objects("Pod")
+    bound = [p for p in pods if p.spec.node_name]
+    assert len(bound) == 3
+    # simulate_kubelet flips bound pods to Running.
+    assert all(p.status.phase == "Running" for p in bound)
+
+
+def test_run_with_leader_election(tmp_path):
+    """Leader-elected run executes the loop and can be stopped."""
+    cluster = build_cluster_from_dict(EXAMPLE_STATE)
+    opt = ServerOption(
+        enable_leader_election=True,
+        lock_object_namespace=str(tmp_path),
+        once=True,
+        listen_address="127.0.0.1:0",
+    )
+    done = threading.Event()
+
+    def target():
+        run(opt, cluster=cluster)
+        done.set()
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    assert done.wait(timeout=30)
+    bound = [p for p in cluster.list_objects("Pod") if p.spec.node_name]
+    assert len(bound) == 3
+    # Lease file is released after run.
+    assert not os.path.exists(os.path.join(str(tmp_path), "tpu-batch-leader.lock"))
