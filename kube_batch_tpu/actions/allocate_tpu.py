@@ -78,7 +78,6 @@ class AllocateTpuAction(Action):
         # (kernel feas mask), its queue must not be overused
         # (allocate.go:94-95), and among eligible nodes the best-scored one
         # wins, mirroring PrioritizeNodes → SelectBestNode.
-        feas = np.asarray(inputs.feas)
         for i, task in enumerate(ctx.tasks):
             if int(assigned[i]) >= 0:
                 continue
@@ -88,10 +87,11 @@ class AllocateTpuAction(Action):
             queue = ssn.queues.get(job.queue)
             if queue is not None and ssn.overused(queue):
                 continue
+            feas_row = ctx.mask.row(i)
             candidates = [
                 ssn.nodes[node.name]
                 for j, node in enumerate(ctx.nodes)
-                if feas[i, j]
+                if feas_row[j]
                 and task.init_resreq.less_equal(ssn.nodes[node.name].releasing)
             ]
             if not candidates:

@@ -112,44 +112,32 @@ class LeaderElector:
         os.replace(tmp, self.lock_path)
 
     def try_acquire(self) -> bool:
-        lease = self._read_lease()
-        now = time.time()
-        if lease is not None and lease["holder"] == self.identity:
-            # Renewal of our own lease: os.replace over a file we hold.
-            self._write_lease()
-            lease = self._read_lease()
-            self.is_leader = bool(lease and lease["holder"] == self.identity)
-            return self.is_leader
-        if lease is not None and now - lease["renew_ts"] <= self.lease_duration:
-            self.is_leader = False
-            return False
-        # Lease absent or stale. Claim a stale lease by renaming it to a
-        # per-identity tomb (atomic — exactly one claimer succeeds; the
-        # loser's rename raises FileNotFoundError and it must win the O_EXCL
-        # create below instead), then contend on exclusive creation so two
-        # starters can never both see themselves as holder.
-        if lease is not None:
-            tomb = f"{self.lock_path}.{self.identity}.stale"
+        """Compare-and-swap on the lease, serialized by an flock mutex.
+
+        The reference's resourcelock does CAS through the API server's
+        resourceVersion; plain rename/O_EXCL dances cannot express
+        'replace only if unchanged' (a holder resuming from a long stall
+        could clobber a freshly stolen lease → split brain), so the
+        read-check-write runs under an exclusive flock on a sidecar mutex
+        file instead."""
+        import fcntl
+
+        with open(f"{self.lock_path}.mutex", "a+") as mutex:
+            fcntl.flock(mutex, fcntl.LOCK_EX)
             try:
-                os.rename(self.lock_path, tomb)
-            except OSError:
-                pass
-            else:
-                try:
-                    os.remove(tomb)
-                except OSError:
-                    pass
-        try:
-            fd = os.open(
-                self.lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644
-            )
-        except FileExistsError:
-            self.is_leader = False
-            return False
-        with os.fdopen(fd, "w") as f:
-            json.dump({"holder": self.identity, "renew_ts": time.time()}, f)
-        self.is_leader = True
-        return True
+                lease = self._read_lease()
+                now = time.time()
+                can_take = (
+                    lease is None
+                    or lease["holder"] == self.identity
+                    or now - lease["renew_ts"] > self.lease_duration
+                )
+                if can_take:
+                    self._write_lease()
+                self.is_leader = can_take
+                return self.is_leader
+            finally:
+                fcntl.flock(mutex, fcntl.LOCK_UN)
 
     def run(self, on_started_leading, on_stopped_leading) -> None:
         """Block until leadership is acquired, then run the payload while

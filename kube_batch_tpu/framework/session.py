@@ -54,6 +54,7 @@ class Session:
         self.task_order_fns: Dict[str, Callable] = {}
         self.predicate_fns: Dict[str, Callable] = {}
         self.batch_predicate_fns: Dict[str, Callable] = {}
+        self.batch_task_order_key_fns: Dict[str, Callable] = {}
         self.preemptable_fns: Dict[str, Callable] = {}
         self.reclaimable_fns: Dict[str, Callable] = {}
         self.overused_fns: Dict[str, Callable] = {}
@@ -248,9 +249,16 @@ class Session:
         self.predicate_fns[name] = fn
 
     def add_batch_predicate_fn(self, name, fn):
-        """TPU-native extension: vectorized predicate producing a [T,N] bool
-        mask for a whole task batch at once (consumed by ops.mask)."""
+        """TPU-native extension: vectorized predicate producing a
+        solver BatchMask (or legacy [T,N] bool array) for a whole task
+        batch at once (consumed by solver.snapshot)."""
         self.batch_predicate_fns[name] = fn
+
+    def add_batch_task_order_key_fn(self, name, fn):
+        """TPU-native extension: (tasks) -> ascending sort-key array
+        equivalent to the plugin's task_order_fn, enabling vectorized
+        task ordering in the snapshot path."""
+        self.batch_task_order_key_fns[name] = fn
 
     def add_preemptable_fn(self, name, fn):
         self.preemptable_fns[name] = fn
@@ -460,6 +468,23 @@ class Session:
     # The batched seams honor the same per-tier enable flags as their
     # scalar counterparts, so allocate and allocate_tpu see identical
     # policy for a given scheduler conf.
+
+    def batch_task_order_keys(self, tasks):
+        """List of ascending key arrays (tier order) reproducing
+        task_order_fn, or None if an enabled task-order plugin has no
+        batch key form (callers then fall back to comparison sorting)."""
+        keys: List = []
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_task_order):
+                    continue
+                if self.task_order_fns.get(plugin.name) is None:
+                    continue
+                kfn = self.batch_task_order_key_fns.get(plugin.name)
+                if kfn is None:
+                    return None
+                keys.append(kfn(tasks))
+        return keys
 
     def batch_predicates(self) -> List:
         """(name, fn) of enabled batched predicates, tier-gated like

@@ -147,18 +147,23 @@ class NodeOrderPlugin(Plugin):
         pa_weight = self._weight(POD_AFFINITY_WEIGHT)
 
         def batch_affinity_scores(tasks, nodes):
-            T, N = len(tasks), len(nodes)
-            out = np.zeros((T, N), dtype=np.float32)
+            """Sparse per-task score rows: only tasks carrying preferred
+            node affinity or pod affinity contribute (solver/masks.py
+            combine_score_rows folds the dict into the device inputs)."""
+            N = len(nodes)
+            rows = {}
             for i, task in enumerate(tasks):
                 aff = task.pod.spec.affinity
                 if aff is None or not (aff.node_preferred or aff.pod_affinity):
                     continue
+                row = np.empty(N, dtype=np.float32)
                 for j, node in enumerate(nodes):
-                    out[i, j] = (
+                    row[j] = (
                         node_affinity_score(task, node) * na_weight
                         + inter_pod(task, node) * pa_weight
                     )
-            return out
+                rows[i] = row
+            return rows
 
         ssn.add_batch_node_order_fn(self.name(), batch_affinity_scores)
 

@@ -208,44 +208,28 @@ class PredicatesPlugin(Plugin):
 
         ssn.add_predicate_fn(self.name(), predicate_fn)
 
-        def batch_predicate_fn(
-            tasks: List[TaskInfo], nodes: List[NodeInfo]
-        ) -> np.ndarray:
-            """[T, N] bool mask of the static (non-pod-affinity) predicates.
+        def batch_predicate_fn(tasks: List[TaskInfo], nodes: List[NodeInfo]):
+            """Factorized feasibility (solver/masks.BatchMask).
 
-            Node-level checks are evaluated once per node column. Per-pair
-            checks run ONLY for tasks that actually carry a selector,
-            affinity, host ports, or live next to node taints — the common
-            case (plain resource-only pods) costs O(N), not O(T*N), which
-            is what keeps host-side snapshotting off the critical path at
-            50k tasks x 5k nodes."""
+            Node-level checks (conditions, unschedulable, pressure,
+            pod-count) produce one [N] column mask. Tolerations, node
+            selectors, and required node affinity are functions of the pod
+            TEMPLATE, not the pod — tasks are grouped by their
+            (tolerations, selector, affinity) signature and each of the G
+            distinct signatures is evaluated against all nodes once:
+            O(N + G·N) host work instead of O(T·N). Host ports and
+            inter-pod (anti-)affinity depend on per-node session state and
+            get private per-task rows (sparse: only tasks that carry
+            them)."""
+            from ..solver.masks import BatchMask
+
             T, N = len(tasks), len(nodes)
-            mask = np.ones((T, N), dtype=bool)
 
-            # Tasks needing per-pair evaluation, by reason.
-            def needs_pair_check(task: TaskInfo) -> bool:
-                spec = task.pod.spec
-                aff = spec.affinity
-                return bool(
-                    spec.node_selector
-                    or any(c.ports for c in spec.containers)
-                    or (
-                        aff is not None
-                        and (
-                            aff.node_required
-                            or aff.pod_affinity
-                            or aff.pod_anti_affinity
-                        )
-                    )
-                )
-
-            pair_tasks = [
-                (i, t) for i, t in enumerate(tasks) if needs_pair_check(t)
-            ]
-
+            node_ok = np.ones(N, dtype=bool)
+            tainted: List[int] = []
             for j, node in enumerate(nodes):
                 try:
-                    check_node_condition(tasks[0] if tasks else None, node)
+                    check_node_condition(None, node)
                     check_node_unschedulable(None, node)
                     if mem_enable:
                         _check_pressure(node, "MemoryPressure", "x")
@@ -254,35 +238,101 @@ class PredicatesPlugin(Plugin):
                     if pid_enable:
                         _check_pressure(node, "PIDPressure", "x")
                 except PredicateError:
-                    mask[:, j] = False
+                    node_ok[j] = False
                     continue
                 if 0 < node.allocatable.max_task_num <= len(node.tasks):
-                    mask[:, j] = False
+                    node_ok[j] = False
                     continue
-
-                # Taints apply to every task (tolerations vary per task);
-                # nodes without taints skip the column entirely.
                 if node.node is not None and node.node.spec.taints:
-                    for i, task in enumerate(tasks):
-                        try:
-                            pod_tolerates_node_taints(task, node)
-                        except PredicateError:
-                            mask[i, j] = False
+                    tainted.append(j)
 
-                for i, task in pair_tasks:
-                    if not mask[i, j]:
+            # Group tasks by template signature.
+            def signature(task: TaskInfo):
+                spec = task.pod.spec
+                tol = tuple(
+                    (t.key, t.operator, t.value, t.effect)
+                    for t in spec.tolerations
+                )
+                sel = tuple(sorted(spec.node_selector.items()))
+                aff = spec.affinity
+                req_aff = (
+                    _terms_sig(aff.node_required)
+                    if aff is not None and aff.node_required
+                    else None
+                )
+                return (tol, sel, req_aff)
+
+            def _terms_sig(terms):
+                return tuple(
+                    (
+                        t.get("key"),
+                        t.get("operator"),
+                        tuple(t.get("values") or ()),
+                    )
+                    for t in terms
+                )
+
+            sig_to_group: dict = {}
+            task_group = np.empty(T, dtype=np.int32)
+            reps: List[TaskInfo] = []
+            for i, task in enumerate(tasks):
+                sig = signature(task)
+                g = sig_to_group.get(sig)
+                if g is None:
+                    g = sig_to_group[sig] = len(reps)
+                    reps.append(task)
+                task_group[i] = g
+
+            group_rows = np.ones((len(reps), N), dtype=bool)
+            for g, rep in enumerate(reps):
+                spec = rep.pod.spec
+                for j in tainted:
+                    try:
+                        pod_tolerates_node_taints(rep, nodes[j])
+                    except PredicateError:
+                        group_rows[g, j] = False
+                aff = spec.affinity
+                if spec.node_selector or (
+                    aff is not None and aff.node_required
+                ):
+                    for j in range(N):
+                        if not (node_ok[j] and group_rows[g, j]):
+                            continue
+                        try:
+                            pod_match_node_selector(rep, nodes[j])
+                        except PredicateError:
+                            group_rows[g, j] = False
+
+            # Private rows: host ports and inter-pod (anti-)affinity.
+            rows = {}
+            for i, task in enumerate(tasks):
+                aff = task.pod.spec.affinity
+                has_ports = any(c.ports for c in task.pod.spec.containers)
+                has_pod_aff = aff is not None and (
+                    aff.pod_affinity or aff.pod_anti_affinity
+                )
+                if not (has_ports or has_pod_aff):
+                    continue
+                row = np.ones(N, dtype=bool)
+                for j, node in enumerate(nodes):
+                    if not (node_ok[j] and group_rows[task_group[i], j]):
+                        row[j] = False
                         continue
                     try:
-                        pod_match_node_selector(task, node)
-                        pod_fits_host_ports(task, node)
-                        aff = task.pod.spec.affinity
-                        if aff is not None and (
-                            aff.pod_affinity or aff.pod_anti_affinity
-                        ):
+                        if has_ports:
+                            pod_fits_host_ports(task, node)
+                        if has_pod_aff:
                             check_pod_affinity(task, node)
                     except PredicateError:
-                        mask[i, j] = False
-            return mask
+                        row[j] = False
+                rows[i] = row
+
+            return BatchMask(
+                node_ok=node_ok,
+                task_group=task_group,
+                group_rows=group_rows,
+                rows=rows,
+            )
 
         ssn.add_batch_predicate_fn(self.name(), batch_predicate_fn)
 

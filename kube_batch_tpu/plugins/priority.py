@@ -22,6 +22,14 @@ class PriorityPlugin(Plugin):
 
         ssn.add_task_order_fn(self.name(), task_order_fn)
 
+        def batch_task_order_key(tasks):
+            import numpy as np
+
+            # Ascending key ≡ task_order_fn: higher priority first.
+            return np.asarray([-t.priority for t in tasks], np.float64)
+
+        ssn.add_batch_task_order_key_fn(self.name(), batch_task_order_key)
+
         def job_order_fn(l, r) -> int:
             if l.priority > r.priority:
                 return -1

@@ -7,23 +7,36 @@ jitted for TPU, with a sharded multi-chip variant.
 """
 
 from .kernels import (
+    PackedInputs,
     SolverInputs,
     SolverResult,
+    build_feasibility,
+    build_static_score,
     dynamic_scores,
     less_equal,
+    make_inputs,
     segmented_cumsum,
     solve,
     solve_jit,
 )
+from .masks import BatchMask, CombinedMask, combine_masks, combine_score_rows
 from .snapshot import ResourceLayout, SnapshotContext, tensorize
 
 __all__ = [
+    "PackedInputs",
     "SolverInputs",
     "SolverResult",
+    "BatchMask",
+    "CombinedMask",
     "ResourceLayout",
     "SnapshotContext",
+    "build_feasibility",
+    "build_static_score",
+    "combine_masks",
+    "combine_score_rows",
     "dynamic_scores",
     "less_equal",
+    "make_inputs",
     "segmented_cumsum",
     "solve",
     "solve_jit",

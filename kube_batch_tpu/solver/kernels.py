@@ -65,7 +65,16 @@ MAX_PRIORITY = 10.0
 class SolverInputs(NamedTuple):
     """Dense snapshot of one scheduling session, ready for the kernel.
 
-    Shapes: T pending tasks, N nodes, R resource dims, Q queues.
+    Shapes: T pending tasks, N nodes, R resource dims, Q queues, G
+    feasibility groups, P private-row tasks, S static-score rows. T and N
+    may include padding; padded tasks have ``task_valid`` False and padded
+    nodes have ``node_feas`` False.
+
+    The [T, N] feasibility mask and static score matrix are NOT shipped
+    from the host — they are factorized (solver/masks.py) into a node
+    column mask, per-group rows (pod templates sharing
+    tolerations/selectors), and sparse per-task rows, and materialized
+    on-device by :func:`build_feasibility` / :func:`build_static_score`.
     """
 
     task_req: jnp.ndarray        # f32[T, R] resreq (subtracted on allocate)
@@ -73,8 +82,14 @@ class SolverInputs(NamedTuple):
     task_rank: jnp.ndarray       # i32[T] global priority rank, smaller first
     task_job: jnp.ndarray        # i32[T] dense job index (< T)
     task_queue: jnp.ndarray      # i32[T] queue index
-    feas: jnp.ndarray            # bool[T, N] static predicate mask
-    static_score: jnp.ndarray    # f32[T, N] host-computed score terms
+    task_valid: jnp.ndarray      # bool[T] False for padding rows
+    task_group: jnp.ndarray      # i32[T] feasibility group per task
+    node_feas: jnp.ndarray       # bool[N] node-level predicate column
+    group_feas: jnp.ndarray      # bool[G, N] per-group node masks
+    pair_idx: jnp.ndarray        # i32[P] tasks with private rows
+    pair_feas: jnp.ndarray       # bool[P, N]
+    score_idx: jnp.ndarray       # i32[S] tasks with static score rows
+    score_rows: jnp.ndarray      # f32[S, N]
     node_idle: jnp.ndarray       # f32[N, R]
     node_releasing: jnp.ndarray  # f32[N, R] resources being released
     node_cap: jnp.ndarray        # f32[N, R] allocatable
@@ -85,6 +100,120 @@ class SolverInputs(NamedTuple):
     eps: jnp.ndarray             # f32[R] per-dimension epsilon
     lr_weight: jnp.ndarray       # f32[] LeastRequested weight
     br_weight: jnp.ndarray       # f32[] BalancedResourceAllocation weight
+
+
+class PackedInputs(NamedTuple):
+    """Transfer-optimized form of :class:`SolverInputs`.
+
+    Each host→device copy is a round trip (costly over a tunneled TPU) and
+    each *eager* device op compiles its own tiny XLA program, so the
+    snapshot ships a handful of stacked buffers and ``solve`` carves the
+    fields out INSIDE the jitted computation, where slicing is free.
+    """
+
+    task_f32: jnp.ndarray   # [2, T, R] req, fit
+    task_i32: jnp.ndarray   # [5, T] rank, queue, job, group, valid
+    node_f32: jnp.ndarray   # [3, N, R] idle, releasing, cap
+    node_i32: jnp.ndarray   # [3, N] task_count, max_tasks, feas
+    group_feas: jnp.ndarray # bool[G, N]
+    pair_idx: jnp.ndarray   # i32[P]
+    pair_feas: jnp.ndarray  # bool[P, N]
+    score_idx: jnp.ndarray  # i32[S]
+    score_rows: jnp.ndarray # f32[S, N]
+    queue_f32: jnp.ndarray  # [2, Q, R] deserved, allocated
+    misc: jnp.ndarray       # f32[R + 2] eps, lr_weight, br_weight
+
+    def unpack(self) -> "SolverInputs":
+        R = self.task_f32.shape[2]
+        return SolverInputs(
+            task_req=self.task_f32[0],
+            task_fit=self.task_f32[1],
+            task_rank=self.task_i32[0],
+            task_queue=self.task_i32[1],
+            task_job=self.task_i32[2],
+            task_group=self.task_i32[3],
+            task_valid=self.task_i32[4].astype(bool),
+            node_feas=self.node_i32[2].astype(bool),
+            group_feas=self.group_feas,
+            pair_idx=self.pair_idx,
+            pair_feas=self.pair_feas,
+            score_idx=self.score_idx,
+            score_rows=self.score_rows,
+            node_idle=self.node_f32[0],
+            node_releasing=self.node_f32[1],
+            node_cap=self.node_f32[2],
+            node_task_count=self.node_i32[0],
+            node_max_tasks=self.node_i32[1],
+            queue_deserved=self.queue_f32[0],
+            queue_allocated=self.queue_f32[1],
+            eps=self.misc[:R],
+            lr_weight=self.misc[R],
+            br_weight=self.misc[R + 1],
+        )
+
+
+def make_inputs(
+    *,
+    feas: jnp.ndarray = None,
+    static_score: jnp.ndarray = None,
+    **kw,
+) -> SolverInputs:
+    """Convenience constructor for tests/tools that have dense [T, N]
+    mask/score matrices: folds them into the factorized fields."""
+    T = kw["task_req"].shape[0]
+    N = kw["node_idle"].shape[0]
+    kw.setdefault("task_valid", jnp.ones((T,), bool))
+    kw.setdefault("node_feas", jnp.ones((N,), bool))
+    if feas is not None:
+        kw.setdefault("task_group", jnp.arange(T, dtype=jnp.int32))
+        kw.setdefault("group_feas", jnp.asarray(feas, bool))
+    else:
+        kw.setdefault("task_group", jnp.zeros((T,), jnp.int32))
+        kw.setdefault("group_feas", jnp.ones((1, N), bool))
+    kw.setdefault("pair_idx", jnp.zeros((0,), jnp.int32))
+    kw.setdefault("pair_feas", jnp.zeros((0, N), bool))
+    if static_score is not None and bool((static_score != 0).any()):
+        kw.setdefault("score_idx", jnp.arange(T, dtype=jnp.int32))
+        kw.setdefault("score_rows", jnp.asarray(static_score, jnp.float32))
+    else:
+        kw.setdefault("score_idx", jnp.zeros((0,), jnp.int32))
+        kw.setdefault("score_rows", jnp.zeros((0, N), jnp.float32))
+    return SolverInputs(**kw)
+
+
+def build_feasibility(inputs: SolverInputs) -> jnp.ndarray:
+    """Materialize the [T, N] static predicate mask on-device."""
+    T = inputs.task_req.shape[0]
+    N = inputs.node_idle.shape[0]
+    feas = (
+        inputs.group_feas[inputs.task_group]
+        & inputs.node_feas[None, :]
+        & inputs.task_valid[:, None]
+    )
+    P = inputs.pair_idx.shape[0]
+    if P:
+        # Private rows AND into (not replace) the group/column mask, like
+        # CombinedMask.row host-side. Extra row T absorbs padded scatter
+        # indices; sliced off after.
+        ext = jnp.ones((T + 1, N), bool).at[inputs.pair_idx].set(
+            inputs.pair_feas
+        )
+        feas = feas & ext[:T]
+    return feas
+
+
+def build_static_score(inputs: SolverInputs) -> jnp.ndarray:
+    """Materialize the [T, N] static score matrix on-device (0.0 if no
+    plugin contributed rows — broadcastable scalar)."""
+    T = inputs.task_req.shape[0]
+    N = inputs.node_idle.shape[0]
+    S = inputs.score_idx.shape[0]
+    if not S:
+        return jnp.zeros((), jnp.float32)
+    ext = jnp.zeros((T + 1, N), jnp.float32).at[inputs.score_idx].add(
+        inputs.score_rows
+    )
+    return ext[:T]
 
 
 class SolverResult(NamedTuple):
@@ -195,8 +324,11 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
     """Run the round-based batched allocation to a fixed point.
 
     Jit-safe; wrap with `jax.jit(solve, static_argnames=("max_rounds",))`
-    (exported as `solve_jit`).
+    (exported as `solve_jit`). Accepts either :class:`SolverInputs` or the
+    transfer-optimized :class:`PackedInputs`.
     """
+    if isinstance(inputs, PackedInputs):
+        inputs = inputs.unpack()
     T, R = inputs.task_req.shape
     N = inputs.node_idle.shape[0]
     Q = inputs.queue_deserved.shape[0]
@@ -205,6 +337,12 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
     # Pad node tables with one dummy row (index N) for tasks with no bid.
     idle0 = inputs.node_idle
     arange_t = jnp.arange(T, dtype=jnp.int32)
+
+    # Materialize the factorized predicate mask / static scores on-device
+    # (masks.py): O(T + G·N + P·N) crosses the host↔device boundary, not
+    # the 250 MB dense [T, N] mask.
+    feas0 = build_feasibility(inputs)
+    static_score = build_static_score(inputs)
 
     # Greedy's resource-fit predicate passes when a task fits Idle OR
     # Releasing (allocate.go:73-87); only a task that fits NEITHER anywhere
@@ -218,7 +356,7 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
             inputs.node_releasing[None, :, :],
             eps,
         )
-        & inputs.feas,
+        & feas0,
         axis=1,
     )                                                             # [T]
 
@@ -244,7 +382,10 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
         # Queue overused (proportion.go:198): deserved <= allocated.
         q_over = less_equal(inputs.queue_deserved, qalloc, eps)   # [Q]
         task_ok = (
-            pending & ~q_over[inputs.task_queue] & ~job_blocked(failed)
+            pending
+            & inputs.task_valid
+            & ~q_over[inputs.task_queue]
+            & ~job_blocked(failed)
         )                                                         # [T]
 
         # Feasibility against current idle (+ pod-count capacity).
@@ -254,7 +395,7 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
         cap_ok = (inputs.node_max_tasks == 0) | (
             ntask < inputs.node_max_tasks
         )                                                         # [N]
-        mask = fits & inputs.feas & cap_ok[None, :] & task_ok[:, None]
+        mask = fits & feas0 & cap_ok[None, :] & task_ok[:, None]
 
         # Tasks with no feasible node fail permanently — unless they fit
         # some node's Releasing resources, in which case greedy would
@@ -273,7 +414,7 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
                 inputs.task_req, idle, inputs.node_cap,
                 inputs.lr_weight, inputs.br_weight,
             )
-            + inputs.static_score
+            + static_score
             + tie_jitter(T, N)
         )
         score = jnp.where(mask, score, -jnp.inf)

@@ -86,6 +86,7 @@ class SnapshotContext:
     tasks: List[TaskInfo]
     nodes: List[NodeInfo]
     queues: List[QueueInfo]
+    mask: Optional["CombinedMask"] = None  # host-side feasibility rows
 
 
 def _sorted_by(items, less_fn):
@@ -102,15 +103,48 @@ def _sorted_by(items, less_fn):
     return sorted(items, key=functools.cmp_to_key(cmp))
 
 
-def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None):
+def _resource_matrix(resources, layout: ResourceLayout) -> np.ndarray:
+    """Columnar [K, R] matrix from Resource objects (no per-item vec())."""
+    out = np.zeros((len(resources), layout.dims), dtype=np.float64)
+    out[:, 0] = [r.milli_cpu for r in resources]
+    out[:, 1] = np.asarray([r.memory for r in resources], np.float64) / MIB
+    for i, name in enumerate(layout.scalars):
+        out[:, 2 + i] = [
+            (r.scalar_resources or {}).get(name, 0.0) for r in resources
+        ]
+    return out
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _task_bucket(n: int) -> int:
+    """Shape bucket for the task axis: fine-grained below 4096, multiples
+    of 2048 above — bounds distinct jit compilations as cluster load
+    fluctuates cycle to cycle while wasting <6% padding at 50k."""
+    return _round_up(n, 256) if n <= 4096 else _round_up(n, 2048)
+
+
+def _pow2(n: int) -> int:
+    if n <= 0:
+        return 0
+    return 1 << (n - 1).bit_length()
+
+
+def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
     """Build `(SolverInputs, SnapshotContext)` for the session's pending,
     non-best-effort tasks, or ``(None, None)`` if there is nothing to solve.
 
     ``include_jobs`` restricts the task set (used by tests and by actions
-    that solve for a subset)."""
+    that solve for a subset). With ``pad`` (default), array shapes are
+    rounded up to buckets (padded tasks/nodes are marked invalid) so a
+    long-running scheduler re-jits only when the cluster crosses a bucket
+    boundary, not on every snapshot."""
     import jax.numpy as jnp
 
-    from .kernels import SolverInputs
+    from .kernels import PackedInputs
+    from .masks import combine_masks, combine_score_rows
 
     layout = ResourceLayout.for_session(ssn)
 
@@ -130,19 +164,57 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None):
             continue
         jobs_by_queue.setdefault(job.queue, []).append(job)
 
-    # Per-queue task sequences (jobs by job_order_fn, tasks by task_order_fn).
-    queue_sequences: Dict[str, List[TaskInfo]] = {}
+    # Per-queue task sequences (jobs by job_order_fn, tasks by
+    # task_order_fn). Jobs are few (comparison sort is fine); tasks are
+    # many, so when every enabled task-order plugin provides a batch key
+    # (batch_task_order_keys) all jobs' pending tasks are ordered with ONE
+    # numpy lexsort — per-job blocks stay intact via the block id key, and
+    # the (creation_timestamp, uid) tiebreak matches task_order_fn.
+    pending_all: List[TaskInfo] = []
+    pending_block: List[int] = []
+    block_bounds: List[Tuple[str, int, int]] = []  # (queue uid, start, end)
     for q in queue_order:
-        seq: List[TaskInfo] = []
         for job in _sorted_by(jobs_by_queue.get(q.uid, []), ssn.job_order_fn):
-            pending = list(
-                job.task_status_index.get(TaskStatus.PENDING, {}).values()
+            pending = [
+                t
+                for t in job.task_status_index.get(
+                    TaskStatus.PENDING, {}
+                ).values()
+                if not t.resreq.is_empty()
+                # BestEffort: allocate skips (allocate.go:103-117)
+            ]
+            start = len(pending_all)
+            pending_all.extend(pending)
+            pending_block.extend([len(block_bounds)] * len(pending))
+            block_bounds.append((q.uid, start, len(pending_all)))
+
+    queue_sequences: Dict[str, List[TaskInfo]] = {
+        q.uid: [] for q in queue_order
+    }
+    batch_keys = (
+        ssn.batch_task_order_keys(pending_all) if pending_all else []
+    )
+    if batch_keys is None:
+        for quid, start, end in block_bounds:
+            queue_sequences[quid].extend(
+                _sorted_by(pending_all[start:end], ssn.task_order_fn)
             )
-            for task in _sorted_by(pending, ssn.task_order_fn):
-                if task.resreq.is_empty():
-                    continue  # BestEffort: allocate skips (allocate.go:108)
-                seq.append(task)
-        queue_sequences[q.uid] = seq
+    else:
+        uids = np.asarray([t.uid or "" for t in pending_all])
+        ts = np.asarray(
+            [t.pod.metadata.creation_timestamp for t in pending_all],
+            np.float64,
+        )
+        order = np.lexsort(
+            tuple([uids, ts])
+            + tuple(reversed(batch_keys))
+            + (np.asarray(pending_block, np.int64),)
+        )
+        # Block id is the primary key, so the result is grouped by job;
+        # one pass distributes tasks to their queue sequence in order.
+        for idx in order:
+            quid = block_bounds[pending_block[idx]][0]
+            queue_sequences[quid].append(pending_all[idx])
 
     # Global priority ranks via PROGRESSIVE FILLING: the greedy loop pops
     # the lowest-share queue each turn (queue PQ re-pushed per iteration,
@@ -162,48 +234,68 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None):
                 queue_budgets[q.uid] = budget
                 break
 
-    keyed: List[Tuple[float, int, int, TaskInfo]] = []
+    # Flatten tasks in (queue-rank, in-queue) order, columnar from here on.
+    flat_tasks: List[TaskInfo] = []
+    flat_qi: List[int] = []
+    flat_pos: List[int] = []
+    queue_blocks: List[Tuple[str, int, int]] = []  # (uid, start, end)
     for q in queue_order:
-        qi = queue_index[q.uid]
-        budget = queue_budgets.get(q.uid)
-        if budget is not None:
-            deserved, allocated = budget
-            cum = allocated.clone()
-        for pos, task in enumerate(queue_sequences[q.uid]):
-            if budget is None:
-                key = 0.0
-            else:
-                cum = cum.clone().add(task.resreq)
-                key = max(
-                    (
-                        share_fn(cum.get(rn), deserved.get(rn))
-                        for rn in deserved.resource_names()
-                    ),
-                    default=0.0,
-                )
-            keyed.append((key, qi, pos, task))
-    keyed.sort(key=lambda e: (e[0], e[1], e[2]))
-
-    tasks = [e[3] for e in keyed]
-    task_queue_ids = [e[1] for e in keyed]
-    if not tasks:
+        seq = queue_sequences[q.uid]
+        start = len(flat_tasks)
+        flat_tasks.extend(seq)
+        flat_qi.extend([queue_index[q.uid]] * len(seq))
+        flat_pos.extend(range(len(seq)))
+        queue_blocks.append((q.uid, start, len(flat_tasks)))
+    if not flat_tasks:
         return None, None
 
-    T, N, R = len(tasks), len(nodes), layout.dims
+    T, N, R = len(flat_tasks), len(nodes), layout.dims
+    req_mat = _resource_matrix([t.resreq for t in flat_tasks], layout)
+    fit_mat = _resource_matrix([t.init_resreq for t in flat_tasks], layout)
 
-    task_req = np.stack([layout.vec(t.resreq) for t in tasks])
-    task_fit = np.stack([layout.vec(t.init_resreq) for t in tasks])
-    task_rank = np.arange(T, dtype=np.int32)
-    task_queue = np.asarray(task_queue_ids, dtype=np.int32)
-    job_dense: Dict[str, int] = {}
-    task_job = np.asarray(
-        [job_dense.setdefault(t.job, len(job_dense)) for t in tasks],
-        dtype=np.int32,
+    # Progressive-filling keys, vectorized per queue: cumulative share the
+    # queue reaches after each of its tasks (see module docstring).
+    keys = np.zeros(T, dtype=np.float64)
+    for uid, start, end in queue_blocks:
+        budget = queue_budgets.get(uid)
+        if budget is None or start == end:
+            continue
+        deserved, allocated = budget
+        d_vec = _resource_matrix([deserved], layout)[0]
+        a_vec = _resource_matrix([allocated], layout)[0]
+        dims = [0, 1] + [
+            2 + k
+            for k, name in enumerate(layout.scalars)
+            if name in (deserved.scalar_resources or {})
+        ]
+        cum = a_vec[dims] + np.cumsum(req_mat[start:end, dims], axis=0)
+        d = d_vec[dims]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            shares = np.where(d == 0, (cum > 0).astype(np.float64), cum / d)
+        keys[start:end] = shares.max(axis=1)
+
+    order = np.lexsort(
+        (np.asarray(flat_pos), np.asarray(flat_qi), keys)
     )
+    tasks = [flat_tasks[i] for i in order]
+    task_req = req_mat[order].astype(np.float32)
+    task_fit = fit_mat[order].astype(np.float32)
+    task_queue = np.asarray(flat_qi, np.int32)[order]
+    task_rank = np.arange(T, dtype=np.int32)
+    _, task_job = np.unique(
+        np.asarray([t.job or "" for t in tasks]), return_inverse=True
+    )
+    task_job = task_job.astype(np.int32)
 
-    node_idle = np.stack([layout.vec(n.idle) for n in nodes])
-    node_releasing = np.stack([layout.vec(n.releasing) for n in nodes])
-    node_cap = np.stack([layout.vec(n.allocatable) for n in nodes])
+    node_idle = _resource_matrix(
+        [n.idle for n in nodes], layout
+    ).astype(np.float32)
+    node_releasing = _resource_matrix(
+        [n.releasing for n in nodes], layout
+    ).astype(np.float32)
+    node_cap = _resource_matrix(
+        [n.allocatable for n in nodes], layout
+    ).astype(np.float32)
     node_task_count = np.asarray(
         [len(n.tasks) for n in nodes], dtype=np.int32
     )
@@ -211,26 +303,31 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None):
         [n.allocatable.max_task_num for n in nodes], dtype=np.int32
     )
 
-    # --- predicates → bool mask (tier-gated like Session.predicate_fn) ----
-    feas = np.ones((T, N), dtype=bool)
-    for name, fn in ssn.batch_predicates():
-        feas &= np.asarray(fn(tasks, nodes), dtype=bool)
+    # --- predicates → factorized mask (tier-gated like predicate_fn) ------
+    mask_parts = [fn(tasks, nodes) for name, fn in ssn.batch_predicates()]
     # Scalar-only predicate plugins (no batched form) fall back to the
     # per-pair path so correctness never depends on a plugin being ported.
-    for name, fn in ssn.scalar_only_predicates():
-        for i, task in enumerate(tasks):
-            for j, node in enumerate(nodes):
-                if not feas[i, j]:
-                    continue
-                try:
-                    fn(task, node)
-                except Exception:
-                    feas[i, j] = False
+    scalar_only = ssn.scalar_only_predicates()
+    if scalar_only:
+        dense = np.ones((T, N), dtype=bool)
+        for name, fn in scalar_only:
+            for i, task in enumerate(tasks):
+                for j, node in enumerate(nodes):
+                    if not dense[i, j]:
+                        continue
+                    try:
+                        fn(task, node)
+                    except Exception:
+                        dense[i, j] = False
+        mask_parts.append(dense)
+    mask = combine_masks(mask_parts, T, N)
 
-    # --- static score matrix (tier-gated like node_prioritizers) ----------
-    static_score = np.zeros((T, N), dtype=np.float32)
-    for fn, weight in ssn.batch_node_prioritizers():
-        static_score += weight * np.asarray(fn(tasks, nodes), np.float32)
+    # --- static scores → sparse rows (tier-gated like node_prioritizers) --
+    score_rows_map = combine_score_rows(
+        [(fn(tasks, nodes), weight)
+         for fn, weight in ssn.batch_node_prioritizers()],
+        T, N,
+    )
     # Tie-break jitter is applied in-kernel (kernels.py tie_jitter): fused
     # hash vectors, no host-side [T, N] materialization.
 
@@ -246,27 +343,89 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None):
         queue_deserved[queue_index[q.uid]] = layout.vec(deserved)
         queue_allocated[queue_index[q.uid]] = layout.vec(allocated)
 
-    weights = ssn.solver_dynamic_weights()
-    inputs = SolverInputs(
-        task_req=jnp.asarray(task_req),
-        task_fit=jnp.asarray(task_fit),
-        task_rank=jnp.asarray(task_rank),
-        task_job=jnp.asarray(task_job),
-        task_queue=jnp.asarray(task_queue),
-        feas=jnp.asarray(feas),
-        static_score=jnp.asarray(static_score),
-        node_idle=jnp.asarray(node_idle),
-        node_releasing=jnp.asarray(node_releasing),
-        node_cap=jnp.asarray(node_cap),
-        node_task_count=jnp.asarray(node_task_count),
-        node_max_tasks=jnp.asarray(node_max_tasks),
-        queue_deserved=jnp.asarray(queue_deserved),
-        queue_allocated=jnp.asarray(queue_allocated),
-        eps=jnp.asarray(layout.eps()),
-        lr_weight=jnp.asarray(weights.get("leastrequested", 0.0), jnp.float32),
-        br_weight=jnp.asarray(
-            weights.get("balancedresource", 0.0), jnp.float32
-        ),
+    # --- padding to shape buckets -----------------------------------------
+    Tp = _task_bucket(T) if pad else T
+    Np = _round_up(N, 128) if pad else N
+    task_valid = np.zeros(Tp, dtype=bool)
+    task_valid[:T] = True
+
+    def pad_rows(a, rows, fill=0):
+        if rows == a.shape[0]:
+            return a
+        out = np.full((rows,) + a.shape[1:], fill, dtype=a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    task_req = pad_rows(task_req, Tp)
+    task_fit = pad_rows(task_fit, Tp)
+    task_rank = np.arange(Tp, dtype=np.int32)
+    task_queue = pad_rows(task_queue, Tp)
+    # Padded tasks get unique job ids so they never interact with
+    # job_blocked segment reductions.
+    task_job = np.concatenate(
+        [task_job, np.arange(T, Tp, dtype=np.int32)]
     )
-    ctx = SnapshotContext(layout, tasks, nodes, queue_order)
+    task_group = pad_rows(mask.task_group, Tp)
+    node_feas = pad_rows(mask.node_ok, Np, fill=False)
+    # Pad both axes of the group rows: nodes to Np, and the group count to
+    # a power of two (all-False rows no task references) so the signature
+    # mix churning cycle-to-cycle does not re-jit the solver.
+    group_feas = np.ascontiguousarray(
+        pad_rows(mask.group_rows.T, Np, fill=False).T
+    )
+    Gp = max(1, _pow2(group_feas.shape[0])) if pad else group_feas.shape[0]
+    group_feas = pad_rows(group_feas, Gp, fill=False)
+    node_idle = pad_rows(node_idle, Np)
+    node_releasing = pad_rows(node_releasing, Np)
+    node_cap = pad_rows(node_cap, Np)
+    node_task_count = pad_rows(node_task_count, Np)
+    node_max_tasks = pad_rows(node_max_tasks, Np)
+
+    P = len(mask.pair_idx)
+    Pp = _pow2(P) if pad else P
+    pair_idx = np.full(Pp, Tp, dtype=np.int32)  # Tp = scatter-discard row
+    pair_idx[:P] = mask.pair_idx
+    pair_feas = np.ones((Pp, Np), dtype=bool)
+    pair_feas[:P, :N] = mask.pair_rows
+    pair_feas[:, N:] = False
+
+    S = len(score_rows_map)
+    Sp = _pow2(S) if pad else S
+    score_idx = np.full(Sp, Tp, dtype=np.int32)
+    score_rows = np.zeros((Sp, Np), dtype=np.float32)
+    for k, i in enumerate(sorted(score_rows_map)):
+        score_idx[k] = i
+        score_rows[k, :N] = score_rows_map[i]
+
+    weights = ssn.solver_dynamic_weights()
+    lr_w = float(weights.get("leastrequested", 0.0))
+    br_w = float(weights.get("balancedresource", 0.0))
+
+    # Pack the host→device copies: each device_put is a host↔accelerator
+    # round trip (expensive over a tunneled TPU) and each eager device op
+    # compiles a tiny XLA program, so ship a few stacked buffers;
+    # kernels.solve unpacks them INSIDE the jit (PackedInputs.unpack).
+    inputs = PackedInputs(
+        task_f32=jnp.asarray(np.stack([task_req, task_fit])),
+        task_i32=jnp.asarray(np.stack([
+            task_rank, task_queue, task_job, task_group,
+            task_valid.astype(np.int32),
+        ])),
+        node_f32=jnp.asarray(
+            np.stack([node_idle, node_releasing, node_cap])
+        ),
+        node_i32=jnp.asarray(np.stack([
+            node_task_count, node_max_tasks, node_feas.astype(np.int32),
+        ])),
+        group_feas=jnp.asarray(group_feas),
+        pair_idx=jnp.asarray(pair_idx),
+        pair_feas=jnp.asarray(pair_feas),
+        score_idx=jnp.asarray(score_idx),
+        score_rows=jnp.asarray(score_rows),
+        queue_f32=jnp.asarray(np.stack([queue_deserved, queue_allocated])),
+        misc=jnp.asarray(np.concatenate([
+            layout.eps(), [lr_w, br_w]
+        ]).astype(np.float32)),
+    )
+    ctx = SnapshotContext(layout, tasks, nodes, queue_order, mask)
     return inputs, ctx

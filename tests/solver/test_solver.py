@@ -17,6 +17,7 @@ from kube_batch_tpu.api import PodPhase, build_resource_list
 from kube_batch_tpu.solver import (
     SolverInputs,
     less_equal,
+    make_inputs,
     segmented_cumsum,
     solve,
     tensorize,
@@ -62,7 +63,7 @@ class TestKernelPieces:
         out = np.asarray(segmented_cumsum(x, is_start))
         np.testing.assert_array_equal(out, [1, 2, 3, 1, 2])
 
-    def _inputs(self, task_req, node_idle, **kw):
+    def _inputs(self, task_req, node_idle, feas=None, **kw):
         task_req = jnp.asarray(task_req, jnp.float32)
         node_idle = jnp.asarray(node_idle, jnp.float32)
         T, R = task_req.shape
@@ -73,8 +74,6 @@ class TestKernelPieces:
             task_rank=jnp.arange(T, dtype=jnp.int32),
             task_job=jnp.arange(T, dtype=jnp.int32),  # one job per task
             task_queue=jnp.zeros(T, jnp.int32),
-            feas=jnp.ones((T, N), bool),
-            static_score=jnp.zeros((T, N), jnp.float32),
             node_idle=node_idle,
             node_releasing=jnp.zeros_like(node_idle),
             node_cap=node_idle,
@@ -87,7 +86,7 @@ class TestKernelPieces:
             br_weight=jnp.asarray(1.0, jnp.float32),
         )
         defaults.update(kw)
-        return SolverInputs(**defaults)
+        return make_inputs(feas=feas, **defaults)
 
     def test_all_fit_single_round_spread(self):
         # 2 identical tasks, 2 empty identical nodes: spread is not required
@@ -119,6 +118,19 @@ class TestKernelPieces:
         inputs = self._inputs([[100.0, 0.0]], [[2000.0, 1e9]], feas=feas)
         res = solve(inputs)
         assert int(res.assigned[0]) == -1
+
+    def test_pair_rows_and_into_group_mask(self):
+        # A private pair row must AND with the group/column mask, not
+        # replace it: group mask forbids node 0, pair row allows both.
+        inputs = self._inputs(
+            [[100.0, 0.0]],
+            [[2000.0, 1e9], [2000.0, 1e9]],
+            feas=jnp.asarray([[False, True]]),
+            pair_idx=jnp.asarray([0], jnp.int32),
+            pair_feas=jnp.asarray([[True, True]]),
+        )
+        res = solve(inputs)
+        assert int(res.assigned[0]) == 1
 
     def test_max_tasks_cap(self):
         inputs = self._inputs(
