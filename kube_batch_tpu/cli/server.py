@@ -123,7 +123,15 @@ class LeaderElector:
         import fcntl
 
         with open(f"{self.lock_path}.mutex", "a+") as mutex:
-            fcntl.flock(mutex, fcntl.LOCK_EX)
+            try:
+                # Non-blocking: a peer frozen INSIDE the critical section
+                # must not wedge every other contender forever (flock is
+                # only released on process exit) — failing this attempt
+                # and retrying preserves the lease-expiry liveness story.
+                fcntl.flock(mutex, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                self.is_leader = False
+                return False
             try:
                 lease = self._read_lease()
                 now = time.time()

@@ -17,6 +17,8 @@ the scheduler cache only ever sees ``ClusterAPI``.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from ..api import (
@@ -77,11 +79,23 @@ class InProcessCluster(ClusterAPI):
 
     KINDS = ("Pod", "Node", "PodGroup", "Queue", "PriorityClass")
 
-    def __init__(self, simulate_kubelet: bool = True):
+    def __init__(
+        self,
+        simulate_kubelet: bool = True,
+        kubelet_delay: float = 0.0,
+    ):
+        """``kubelet_delay`` > 0 makes the simulated kubelet flip a bound
+        pod to Running after that many seconds (on a timer thread, with a
+        second MODIFIED event) instead of instantly — gives the perf
+        harness a measurable scheduled→running phase like kubemark's
+        hollow kubelets."""
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[str, object]] = {k: {} for k in self.KINDS}
         self._watchers: List[WatchHandler] = []
         self.simulate_kubelet = simulate_kubelet
+        self.kubelet_delay = kubelet_delay
+        self._kubelet_queue: "deque" = deque()
+        self._kubelet_thread: Optional[threading.Thread] = None
         self.events: List[tuple] = []  # recorded cluster events (observability)
 
     # -- internal -----------------------------------------------------------
@@ -154,9 +168,49 @@ class InProcessCluster(ClusterAPI):
                     f"pod {self._key(pod)} already bound to {stored.spec.node_name}"
                 )
             stored.spec.node_name = hostname
-            if self.simulate_kubelet:
+            if self.simulate_kubelet and self.kubelet_delay <= 0:
                 stored.status.phase = PodPhase.RUNNING
         self._notify("Pod", MODIFIED, stored)
+        if self.simulate_kubelet and self.kubelet_delay > 0:
+            self._enqueue_kubelet_start(self._key(stored))
+
+    def _enqueue_kubelet_start(self, key: str) -> None:
+        """Queue a delayed Pending→Running flip on ONE shared worker
+        thread (a Timer per bind would put thousands of thread spawns
+        inside the latency the perf harness measures)."""
+        deadline = time.monotonic() + self.kubelet_delay
+        with self._lock:
+            self._kubelet_queue.append((deadline, key))
+            if self._kubelet_thread is None or not self._kubelet_thread.is_alive():
+                self._kubelet_thread = threading.Thread(
+                    target=self._kubelet_loop, daemon=True,
+                    name="hollow-kubelet",
+                )
+                self._kubelet_thread.start()
+
+    def _kubelet_loop(self) -> None:
+        while True:
+            with self._lock:
+                if not self._kubelet_queue:
+                    return  # thread exits; next bind restarts it
+                deadline, key = self._kubelet_queue[0]
+            delay = deadline - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            with self._lock:
+                self._kubelet_queue.popleft()
+                # Re-fetch: the pod may have been evicted/deleted while
+                # the delay ran — a stale notify would resurrect it in
+                # the scheduler cache as a RUNNING ghost.
+                pod = self._objects["Pod"].get(key)
+                if (
+                    pod is None
+                    or not pod.spec.node_name
+                    or pod.status.phase != PodPhase.PENDING
+                ):
+                    continue
+                pod.status.phase = PodPhase.RUNNING
+            self._notify("Pod", MODIFIED, pod)
 
     def delete_pod(self, pod: Pod) -> None:
         """Analog of pod DELETE for eviction (reference cache.go:137-148)."""

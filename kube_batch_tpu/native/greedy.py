@@ -1,0 +1,103 @@
+"""ctypes wrapper for native/greedy.cpp (the reference loop baseline).
+
+Builds ``native/build/libgreedy.so`` with ``make -C native`` on first use
+(cached thereafter). numpy in, numpy out; see greedy.cpp for semantics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libgreedy.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+class NativeUnavailable(RuntimeError):
+    """The native library could not be built/loaded on this host."""
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            raise NativeUnavailable(_load_error)
+        try:
+            if not os.path.exists(_SO_PATH):
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            lib = ctypes.CDLL(_SO_PATH)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _load_error = f"native greedy unavailable: {detail}"
+            raise NativeUnavailable(_load_error) from e
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.greedy_allocate.restype = ctypes.c_int64
+        lib.greedy_allocate.argtypes = [
+            f32p, i32p, f32p, f32p, f32p, f32p, f32p,
+            ctypes.c_double, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i32p,
+        ]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def greedy_allocate(
+    task_req: np.ndarray,       # f32[T, R]
+    task_queue: np.ndarray,     # i32[T]
+    node_idle: np.ndarray,      # f32[N, R]
+    node_cap: np.ndarray,       # f32[N, R]
+    queue_deserved: np.ndarray, # f32[Q, R]
+    queue_allocated: np.ndarray,# f32[Q, R]
+    eps: np.ndarray,            # f32[R]
+    lr_weight: float = 1.0,
+    br_weight: float = 1.0,
+) -> Tuple[np.ndarray, int]:
+    """Run the native greedy loop; returns (assignment i32[T], placed)."""
+    lib = _load()
+    task_req = np.ascontiguousarray(task_req, np.float32)
+    task_queue = np.ascontiguousarray(task_queue, np.int32)
+    node_idle = np.ascontiguousarray(node_idle, np.float32)
+    node_cap = np.ascontiguousarray(node_cap, np.float32)
+    queue_deserved = np.ascontiguousarray(queue_deserved, np.float32)
+    queue_allocated = np.ascontiguousarray(queue_allocated, np.float32)
+    eps = np.ascontiguousarray(eps, np.float32)
+    T, R = task_req.shape
+    N = node_idle.shape[0]
+    Q = queue_deserved.shape[0]
+    out = np.empty(T, dtype=np.int32)
+    placed = lib.greedy_allocate(
+        task_req, task_queue, node_idle, node_cap,
+        queue_deserved, queue_allocated, eps,
+        float(lr_weight), float(br_weight),
+        T, N, Q, R, out,
+    )
+    return out, int(placed)

@@ -1,0 +1,229 @@
+"""Density / pod-startup-latency perf harness — the kubemark equivalent.
+
+The reference measures scheduler performance with hollow-node kubemark
+clusters (test/kubemark/start-kubemark.sh) and a density e2e
+(test/e2e/benchmark.go:54 "Schedule Density Job"): schedule TotalPodCount
+pods, watch each pod's lifecycle, compute create→scheduled,
+scheduled→running, running→watched, and e2e percentiles
+(test/e2e/metric_util.go:45-59), and emit a versioned perf JSON artifact
+(benchmark.go:117-148). This module is that harness against the in-process
+hollow cluster (cluster/api.py InProcessCluster with simulated kubelets):
+simulated kubelets, real scheduler — same trade as kubemark.
+
+Run: ``python -m kube_batch_tpu.perf --pods 3000 --nodes 100 --out perf.json``
+(the 3k-pods-on-100-hollow-nodes scale is the reference's design intent,
+doc/design/Benchmark/kubemark/kubemark-benchmarking.md:40).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .api import PodPhase, build_resource_list
+from .cache import SchedulerCache
+from .cluster import InProcessCluster
+from .scheduler import Scheduler
+from .utils.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+# Perf artifact schema version (reference test/e2e/util.go:57
+# currentApiCallMetricsVersion = "v1").
+PERF_VERSION = "v1"
+
+
+def percentiles(values: List[float]) -> Dict[str, float]:
+    """P50/P90/P99/P100 like the reference (metric_util.go:45-52)."""
+    if not values:
+        return {"Perc50": 0.0, "Perc90": 0.0, "Perc99": 0.0, "Perc100": 0.0}
+    xs = sorted(values)
+    n = len(xs)
+    return {
+        "Perc50": xs[n // 2],
+        "Perc90": xs[min(n - 1, (n * 90) // 100)],
+        "Perc99": xs[min(n - 1, (n * 99) // 100)],
+        "Perc100": xs[-1],
+    }
+
+
+class PodWatchRecorder:
+    """Watches pod lifecycle events and records phase timestamps
+    (benchmark.go:66-113: watch-based scheduled/run/watch capture)."""
+
+    def __init__(self, cluster: InProcessCluster):
+        self.lock = threading.Lock()
+        self.created: Dict[str, float] = {}
+        self.scheduled: Dict[str, float] = {}
+        self.running: Dict[str, float] = {}
+        self.watched: Dict[str, float] = {}
+        cluster.add_watch(self._on_event)
+
+    def _key(self, pod) -> str:
+        return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+    def _on_event(self, kind: str, event_type: str, obj) -> None:
+        if kind != "Pod":
+            return
+        now = time.time()
+        key = self._key(obj)
+        with self.lock:
+            if event_type == "ADDED":
+                self.created.setdefault(key, now)
+                return
+            if obj.spec.node_name and key not in self.scheduled:
+                self.scheduled[key] = now
+            if obj.status.phase == PodPhase.RUNNING and key not in self.running:
+                self.running[key] = now
+                self.watched[key] = now
+
+    def all_running(self, keys) -> bool:
+        with self.lock:
+            return all(k in self.running for k in keys)
+
+
+def run_density(
+    total_pods: int = 100,
+    nodes: int = 100,
+    pods_per_group: int = 10,
+    min_member_frac: float = 1.0,
+    node_cpu: str = "32",
+    node_memory: str = "128Gi",
+    pods_per_node: int = 110,
+    pod_cpu: str = "100m",
+    pod_memory: str = "128Mi",
+    schedule_period: float = 0.1,
+    kubelet_delay: float = 0.05,
+    scheduler_conf: Optional[str] = None,
+    timeout: float = 300.0,
+) -> Dict:
+    """Schedule ``total_pods`` gang pods onto hollow nodes; return the
+    perf artifact dict (latencies in ms)."""
+    cluster = InProcessCluster(
+        simulate_kubelet=True, kubelet_delay=kubelet_delay
+    )
+    recorder = PodWatchRecorder(cluster)
+    cache = SchedulerCache(cluster=cluster)
+
+    cluster.create_queue(build_queue("default", weight=1))
+    for j in range(nodes):
+        cluster.create_node(build_node(
+            f"hollow-{j}",
+            build_resource_list(
+                cpu=node_cpu, memory=node_memory, pods=pods_per_node
+            ),
+        ))
+
+    keys = []
+    groups = max(1, total_pods // max(1, pods_per_group))
+    t = 0
+    for g in range(groups):
+        size = pods_per_group if g < groups - 1 else total_pods - t
+        if size <= 0:
+            break
+        min_member = max(1, int(size * min_member_frac))
+        cluster.create_pod_group(build_pod_group(
+            f"density-{g}", namespace="perf", min_member=min_member
+        ))
+        for i in range(size):
+            pod = build_pod(
+                "perf", f"density-{g}-{i}", "", PodPhase.PENDING,
+                build_resource_list(cpu=pod_cpu, memory=pod_memory),
+                group_name=f"density-{g}",
+            )
+            cluster.create_pod(pod)
+            keys.append(f"perf/{pod.metadata.name}")
+            t += 1
+
+    sched = Scheduler(cache, scheduler_conf, schedule_period=schedule_period)
+    stop = threading.Event()
+    thread = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    start = time.time()
+    thread.start()
+    deadline = start + timeout
+    while time.time() < deadline and not recorder.all_running(keys):
+        time.sleep(0.05)
+    wall = time.time() - start
+    stop.set()
+    thread.join(timeout=10)
+
+    with recorder.lock:
+        create_to_sched = [
+            (recorder.scheduled[k] - recorder.created[k]) * 1e3
+            for k in keys if k in recorder.scheduled
+        ]
+        sched_to_run = [
+            (recorder.running[k] - recorder.scheduled[k]) * 1e3
+            for k in keys if k in recorder.running and k in recorder.scheduled
+        ]
+        run_to_watch = [
+            (recorder.watched[k] - recorder.running[k]) * 1e3
+            for k in keys if k in recorder.watched
+        ]
+        e2e = [
+            (recorder.watched[k] - recorder.created[k]) * 1e3
+            for k in keys if k in recorder.watched
+        ]
+        scheduled_count = len(recorder.scheduled)
+        running_count = len(recorder.running)
+
+    return {
+        "version": PERF_VERSION,
+        "metric": "pod_startup_latency",
+        "config": {
+            "total_pods": total_pods,
+            "nodes": nodes,
+            "pods_per_group": pods_per_group,
+            "schedule_period_s": schedule_period,
+            "kubelet_delay_s": kubelet_delay,
+        },
+        "pods_scheduled": scheduled_count,
+        "pods_running": running_count,
+        "wall_seconds": round(wall, 3),
+        "pods_per_second": round(running_count / wall, 1) if wall else 0.0,
+        "dataItems": [
+            {"label": "create_to_scheduled_ms", **percentiles(create_to_sched)},
+            {"label": "scheduled_to_running_ms", **percentiles(sched_to_run)},
+            {"label": "running_to_watched_ms", **percentiles(run_to_watch)},
+            {"label": "e2e_ms", **percentiles(e2e)},
+        ],
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pods", type=int, default=100,
+                    help="total pods (reference benchmark.go:50 uses 100)")
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--group-size", type=int, default=10)
+    ap.add_argument("--min-member-frac", type=float, default=1.0)
+    ap.add_argument("--period", type=float, default=0.1)
+    ap.add_argument("--kubelet-delay", type=float, default=0.05)
+    ap.add_argument("--conf", default=None, help="scheduler policy YAML path")
+    ap.add_argument("--out", default=None, help="write perf JSON artifact")
+    args = ap.parse_args(argv)
+
+    artifact = run_density(
+        total_pods=args.pods,
+        nodes=args.nodes,
+        pods_per_group=args.group_size,
+        min_member_frac=args.min_member_frac,
+        schedule_period=args.period,
+        kubelet_delay=args.kubelet_delay,
+        scheduler_conf=args.conf,
+    )
+    line = json.dumps(artifact)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

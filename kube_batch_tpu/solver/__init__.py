@@ -17,7 +17,11 @@ from .kernels import (
     make_inputs,
     segmented_cumsum,
     solve,
+    solve_auto,
+    solve_full_jit,
     solve_jit,
+    solve_staged,
+    solve_staged_jit,
 )
 from .masks import BatchMask, CombinedMask, combine_masks, combine_score_rows
 from .snapshot import ResourceLayout, SnapshotContext, tensorize
@@ -39,6 +43,10 @@ __all__ = [
     "make_inputs",
     "segmented_cumsum",
     "solve",
+    "solve_auto",
+    "solve_full_jit",
     "solve_jit",
+    "solve_staged",
+    "solve_staged_jit",
     "tensorize",
 ]

@@ -328,8 +328,8 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
          for fn, weight in ssn.batch_node_prioritizers()],
         T, N,
     )
-    # Tie-break jitter is applied in-kernel (kernels.py tie_jitter): fused
-    # hash vectors, no host-side [T, N] materialization.
+    # Tie-breaking happens in-kernel via hashed integer bid keys
+    # (kernels.bid_keys); nothing to materialize host-side.
 
     # --- queue budget vectors ---------------------------------------------
     Qn = max(1, len(queue_order))

@@ -1,0 +1,161 @@
+// Native greedy allocate baseline — the reference's hot loop, faithfully.
+//
+// Reimplements the per-task sequential scan of kube-batch's allocate action
+// (actions/allocate/allocate.go:43-191: per task, PredicateNodes over all
+// nodes -> PrioritizeNodes -> SelectBestNode -> allocate) as tight C++ on
+// the same columnar arrays the TPU solver consumes. Purpose:
+//
+//  1. an HONEST measured baseline for bench.py — the reference publishes no
+//     numbers (BASELINE.md), so "vs the greedy loop" must be measured, and a
+//     compiled-native loop is the fair stand-in for the reference's Go
+//     (extrapolating the Python action's wall time would inflate the
+//     speedup ~50x);
+//  2. a production fallback path when no accelerator is present.
+//
+// Scoring mirrors plugins/nodeorder.py least_requested/balanced (k8s
+// formulas) and the epsilon fit mirrors api/resource_info.py less_equal
+// (resource_info.go:253-277). Tie-break: first best (the reference picks
+// randomly among max-score nodes, scheduler_helper.go:188-208; fixed order
+// changes placement, not cost). Queue gating mirrors proportion's Overused
+// (deserved <= allocated on every dim, proportion.go:198).
+//
+// OpenMP (when compiled with -fopenmp) parallelizes the per-task node scan
+// like the reference's 16-goroutine fan-out (scheduler_helper.go:84,137).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+#include <cmath>
+
+namespace {
+
+constexpr double kMaxPriority = 10.0;
+constexpr int kCpuDim = 0;
+constexpr int kMemDim = 1;
+
+inline bool fits(const float* req, const float* idle, const float* eps,
+                 int64_t R) {
+  for (int64_t d = 0; d < R; ++d) {
+    if (!(req[d] - idle[d] < eps[d])) return false;
+  }
+  return true;
+}
+
+inline bool overused(const float* deserved, const float* alloc,
+                     const float* eps, int64_t R) {
+  // proportion.go:198: deserved LessEqual allocated (every dim).
+  for (int64_t d = 0; d < R; ++d) {
+    if (!(deserved[d] - alloc[d] < eps[d])) return false;
+  }
+  return true;
+}
+
+inline double score(const float* req, const float* idle, const float* cap,
+                    double lr_w, double br_w) {
+  // LeastRequested + BalancedResourceAllocation over {cpu, mem}.
+  double lr = 0.0;
+  double frac[2];
+  for (int d = 0; d < 2; ++d) {
+    double c = cap[d == 0 ? kCpuDim : kMemDim];
+    double remaining = idle[d == 0 ? kCpuDim : kMemDim] -
+                       req[d == 0 ? kCpuDim : kMemDim];
+    if (c > 0) {
+      lr += (remaining > 0 ? remaining : 0.0) * kMaxPriority / c;
+      frac[d] = 1.0 - remaining / c;
+    } else {
+      frac[d] = 1.0;
+    }
+  }
+  lr /= 2.0;
+  double br = 0.0;
+  if (frac[0] < 1.0 && frac[1] < 1.0) {
+    double diff = frac[0] - frac[1];
+    if (diff < 0) diff = -diff;
+    br = kMaxPriority - diff * kMaxPriority;
+  }
+  return lr_w * lr + br_w * br;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Runs the greedy allocate loop. Arrays are row-major float32/int32.
+// node_idle and queue_alloc are COPIED internally; out_assign[T] receives
+// the chosen node index or -1. Returns the number of tasks placed.
+int64_t greedy_allocate(const float* task_req,      // [T, R]
+                        const int32_t* task_queue,  // [T]
+                        const float* node_idle0,    // [N, R]
+                        const float* node_cap,      // [N, R]
+                        const float* queue_deserved,// [Q, R]
+                        const float* queue_alloc0,  // [Q, R]
+                        const float* eps,           // [R]
+                        double lr_w, double br_w,
+                        int64_t T, int64_t N, int64_t Q, int64_t R,
+                        int32_t* out_assign) {
+  std::vector<float> idle(node_idle0, node_idle0 + N * R);
+  std::vector<float> qalloc(queue_alloc0, queue_alloc0 + Q * R);
+  int64_t placed = 0;
+
+  for (int64_t t = 0; t < T; ++t) {
+    out_assign[t] = -1;
+    const float* req = task_req + t * R;
+    const int64_t q = task_queue[t];
+    if (q >= 0 && q < Q &&
+        overused(queue_deserved + q * R, qalloc.data() + q * R, eps, R)) {
+      continue;  // allocate.go:94-95
+    }
+
+    int64_t best = -1;
+    double best_score = -1.0;
+#ifdef _OPENMP
+#pragma omp parallel
+    {
+      int64_t lbest = -1;
+      double lscore = -1.0;
+#pragma omp for nowait
+      for (int64_t n = 0; n < N; ++n) {
+        if (!fits(req, idle.data() + n * R, eps, R)) continue;
+        double s = score(req, idle.data() + n * R, node_cap + n * R,
+                         lr_w, br_w);
+        if (s > lscore || (s == lscore && (lbest < 0 || n < lbest))) {
+          lscore = s;
+          lbest = n;
+        }
+      }
+#pragma omp critical
+      {
+        if (lbest >= 0 &&
+            (lscore > best_score ||
+             (lscore == best_score && (best < 0 || lbest < best)))) {
+          best_score = lscore;
+          best = lbest;
+        }
+      }
+    }
+#else
+    for (int64_t n = 0; n < N; ++n) {
+      if (!fits(req, idle.data() + n * R, eps, R)) continue;
+      double s = score(req, idle.data() + n * R, node_cap + n * R,
+                       lr_w, br_w);
+      if (s > best_score) {
+        best_score = s;
+        best = n;
+      }
+    }
+#endif
+
+    if (best < 0) continue;
+    float* nidle = idle.data() + best * R;
+    for (int64_t d = 0; d < R; ++d) nidle[d] -= req[d];
+    if (q >= 0 && q < Q) {
+      float* qa = qalloc.data() + q * R;
+      for (int64_t d = 0; d < R; ++d) qa[d] += req[d];
+    }
+    out_assign[t] = static_cast<int32_t>(best);
+    ++placed;
+  }
+  return placed;
+}
+
+}  // extern "C"

@@ -1,0 +1,38 @@
+"""Density perf harness smoke test (kube_batch_tpu/perf.py — the kubemark
+equivalent, reference test/e2e/benchmark.go:54). Small scale so the suite
+stays fast; the real runs go through ``python -m kube_batch_tpu.perf``."""
+
+import json
+
+from kube_batch_tpu.perf import percentiles, run_density
+
+
+def test_percentiles_shape():
+    p = percentiles([1.0, 2.0, 3.0, 4.0, 5.0])
+    assert p["Perc50"] == 3.0
+    assert p["Perc100"] == 5.0
+    assert percentiles([])["Perc99"] == 0.0
+
+
+def test_density_small_cluster_runs_all_pods():
+    artifact = run_density(
+        total_pods=40,
+        nodes=8,
+        pods_per_group=10,
+        schedule_period=0.05,
+        kubelet_delay=0.01,
+        timeout=60.0,
+    )
+    assert artifact["pods_running"] == 40
+    assert artifact["pods_scheduled"] == 40
+    labels = [d["label"] for d in artifact["dataItems"]]
+    assert labels == [
+        "create_to_scheduled_ms",
+        "scheduled_to_running_ms",
+        "running_to_watched_ms",
+        "e2e_ms",
+    ]
+    e2e = artifact["dataItems"][3]
+    assert e2e["Perc100"] >= e2e["Perc50"] > 0
+    # Artifact is JSON-serializable (driver writes it to disk).
+    json.dumps(artifact)

@@ -1,0 +1,94 @@
+"""Native greedy baseline (native/greedy.cpp via ctypes).
+
+Parity is asserted against a pure-numpy transcription of the same loop
+(per-task sequential best-node scan with LeastRequested+Balanced scores,
+epsilon fit, queue Overused gating) — the shared contract both mirror is
+the reference allocate loop (allocate.go:43-191)."""
+
+import numpy as np
+import pytest
+
+try:
+    from kube_batch_tpu.native import greedy_allocate, native_available
+    HAVE_NATIVE = native_available()
+except Exception:  # pragma: no cover - no toolchain
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(
+    not HAVE_NATIVE, reason="native toolchain unavailable"
+)
+
+
+def numpy_greedy(task_req, task_queue, node_idle, node_cap, qd, qa, eps,
+                 lr_w=1.0, br_w=1.0):
+    idle = node_idle.astype(np.float64).copy()
+    qalloc = qa.astype(np.float64).copy()
+    cap = node_cap.astype(np.float64)
+    out = np.full(len(task_req), -1, np.int32)
+    for t in range(len(task_req)):
+        req = task_req[t].astype(np.float64)
+        q = int(task_queue[t])
+        if 0 <= q < len(qd) and np.all(qd[q] - qalloc[q] < eps):
+            continue
+        best, best_s = -1, -1.0
+        for n in range(len(idle)):
+            if not np.all(req - idle[n] < eps):
+                continue
+            rem = idle[n] - req
+            cm = cap[n][:2]
+            safe = np.where(cm > 0, cm, 1.0)
+            lr = float(np.mean(
+                np.where(cm > 0, np.maximum(rem[:2], 0) * 10.0 / safe, 0.0)
+            ))
+            frac = np.where(cm > 0, 1.0 - rem[:2] / safe, 1.0)
+            br = 0.0 if np.any(frac >= 1.0) else (
+                10.0 - abs(frac[0] - frac[1]) * 10.0
+            )
+            s = lr_w * lr + br_w * br
+            if s > best_s:
+                best_s, best = s, n
+        if best >= 0:
+            idle[best] -= req
+            if 0 <= q < len(qd):
+                qalloc[q] += req
+            out[t] = best
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_native_matches_numpy_reference(seed):
+    rng = np.random.RandomState(seed)
+    T, N, Q, R = 120, 10, 2, 2
+    task_req = np.c_[
+        rng.choice([250, 500, 1000, 2000], T),
+        rng.choice([256, 1024, 4096], T),
+    ].astype(np.float32)
+    task_queue = rng.randint(0, Q, T).astype(np.int32)
+    node_idle = np.c_[
+        rng.choice([4000, 8000, 16000], N), np.full(N, 32768)
+    ].astype(np.float32)
+    eps = np.asarray([10.0, 10.0], np.float32)
+    qd = np.asarray([[20000.0, 0.0], [np.inf, np.inf]], np.float32)
+    qa = np.zeros((Q, R), np.float32)
+
+    got, placed = greedy_allocate(
+        task_req, task_queue, node_idle, node_idle, qd, qa, eps
+    )
+    want = numpy_greedy(task_req, task_queue, node_idle, node_idle, qd, qa,
+                        eps)
+    np.testing.assert_array_equal(got, want)
+    assert placed == int((want >= 0).sum())
+
+
+def test_queue_overused_gates_tasks():
+    # Queue 0 already at deserved: its task skipped; queue 1 placed.
+    task_req = np.asarray([[100.0, 0.0], [100.0, 0.0]], np.float32)
+    task_queue = np.asarray([0, 1], np.int32)
+    node_idle = np.asarray([[1000.0, 1e9]], np.float32)
+    eps = np.asarray([10.0, 10.0], np.float32)
+    qd = np.asarray([[500.0, 0.0], [np.inf, np.inf]], np.float32)
+    qa = np.asarray([[500.0, 0.0], [0.0, 0.0]], np.float32)
+    out, placed = greedy_allocate(
+        task_req, task_queue, node_idle, node_idle, qd, qa, eps
+    )
+    assert out[0] == -1 and out[1] == 0 and placed == 1
