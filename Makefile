@@ -1,0 +1,38 @@
+# tpu-batch build/test entry points (reference Makefile analog:
+# kube-batch, verify, run-test, e2e, coverage targets).
+
+PY ?= python
+CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+
+.PHONY: all native test e2e perf bench verify clean
+
+all: native
+
+# Native components (greedy baseline / CPU fallback).
+native:
+	$(MAKE) -C native
+
+# Unit + action + solver + e2e suites on the virtual CPU mesh.
+test:
+	$(PY) -m pytest tests/ -x -q
+
+e2e:
+	$(PY) -m pytest tests/e2e -x -q
+
+# Density perf harness at the reference's kubemark design scale
+# (doc/design/Benchmark/kubemark/kubemark-benchmarking.md:40).
+perf:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 3000 --nodes 100 \
+		--group-size 30 --out perf-artifact.json
+
+# Headline benchmark (real accelerator when present).
+bench:
+	$(PY) bench.py
+
+# Static checks: compileall as the gofmt/golint analog.
+verify:
+	$(PY) -m compileall -q kube_batch_tpu tests bench.py __graft_entry__.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

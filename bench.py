@@ -193,6 +193,9 @@ def main():
     ap.add_argument("--quick", action="store_true",
                     help="small+medium only (CI-sized)")
     ap.add_argument("--config", choices=list(CONFIGS), default=None)
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a JAX profiler trace of the headline "
+                         "solve into DIR (view with TensorBoard)")
     args = ap.parse_args()
 
     headline_cfg = args.config or ("medium" if args.quick else "large")
@@ -202,6 +205,14 @@ def main():
 
     tpu = bench_tpu(headline_cfg)
     solve_ms = tpu["solve_s"] * 1e3
+
+    if args.profile:
+        # Profiler hook (SURVEY.md §5 tracing parity: latency histograms
+        # + JAX profiler for the solver): trace one steady-state solve.
+        import jax
+
+        with jax.profiler.trace(args.profile):
+            jax.block_until_ready(solve_jit(tpu["inputs"]))
 
     # vs_baseline: measured NATIVE reference loop at the headline scale
     # (the honest Go-loop stand-in); falls back to the O(T*N)-extrapolated

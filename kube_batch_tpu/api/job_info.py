@@ -184,7 +184,10 @@ class JobInfo:
         return res
 
     def clone(self) -> "JobInfo":
-        """reference job_info.go:290-322"""
+        """Deep copy for the per-cycle snapshot (reference
+        job_info.go:290-322). Like NodeInfo.clone, the aggregate vectors
+        (total_request/allocated) are copied rather than re-accumulated
+        task by task — they are invariants of the task set."""
         info = JobInfo(self.uid)
         info.name = self.name
         info.namespace = self.namespace
@@ -194,8 +197,12 @@ class JobInfo:
         info.node_selector = dict(self.node_selector)
         info.creation_timestamp = self.creation_timestamp
         info.pod_group = self.pod_group
-        for task in self.tasks.values():
-            info.add_task_info(task.clone())
+        info.total_request = self.total_request.clone()
+        info.allocated = self.allocated.clone()
+        for uid, task in self.tasks.items():
+            ti = task.clone()
+            info.tasks[uid] = ti
+            info._add_task_index(ti)
         return info
 
     # -- gang readiness -----------------------------------------------------

@@ -140,10 +140,22 @@ class NodeInfo:
         self.add_task(ti)
 
     def clone(self) -> "NodeInfo":
-        """reference node_info.go:92-100"""
-        res = NodeInfo(self.node)
-        for task in self.tasks.values():
-            res.add_task(task)
+        """Deep copy for the per-cycle snapshot (reference
+        node_info.go:92-100). The reference rebuilds accounting by
+        re-adding every task; here the already-consistent incremental
+        vectors are copied directly — same result (idle/used/releasing
+        are invariants of the task set) without re-parsing the node's
+        quantity strings on every 1 Hz snapshot."""
+        res = NodeInfo.__new__(NodeInfo)
+        res.name = self.name
+        res.node = self.node
+        res.state = NodeState(self.state.phase, self.state.reason)
+        res.releasing = self.releasing.clone()
+        res.idle = self.idle.clone()
+        res.used = self.used.clone()
+        res.allocatable = self.allocatable.clone()
+        res.capability = self.capability.clone()
+        res.tasks = {k: t.clone() for k, t in self.tasks.items()}
         return res
 
     def pods(self) -> List[Pod]:

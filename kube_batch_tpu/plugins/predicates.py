@@ -246,9 +246,16 @@ class PredicatesPlugin(Plugin):
                 if node.node is not None and node.node.spec.taints:
                     tainted.append(j)
 
-            # Group tasks by template signature.
+            # Group tasks by template signature. Pod specs are immutable
+            # after creation (k8s semantics), so the signature is cached
+            # on the pod object — tasks are cloned every snapshot but
+            # share the pod, making this a once-per-pod cost.
             def signature(task: TaskInfo):
-                spec = task.pod.spec
+                pod = task.pod
+                sig = getattr(pod, "_predicate_sig", None)
+                if sig is not None:
+                    return sig
+                spec = pod.spec
                 tol = tuple(
                     (t.key, t.operator, t.value, t.effect)
                     for t in spec.tolerations
@@ -260,7 +267,9 @@ class PredicatesPlugin(Plugin):
                     if aff is not None and aff.node_required
                     else None
                 )
-                return (tol, sel, req_aff)
+                sig = (tol, sel, req_aff)
+                pod._predicate_sig = sig
+                return sig
 
             def _terms_sig(terms):
                 return tuple(

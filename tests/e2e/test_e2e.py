@@ -216,6 +216,40 @@ class TestPredicates:
             ctx.submit(tol)
             assert ctx.wait_tasks_ready("tol", 1)
 
+    def test_pod_anti_affinity_spreads(self):
+        """'Pod Anti-Affinity' (predicates.go:252-262 via vendored k8s
+        checker): replicas carrying anti-affinity against their own label
+        must land on distinct nodes."""
+        with Context(nodes=2, node_cpu="4", node_mem="8Gi") as ctx:
+            pods = ctx.create_job(JobSpec(name="spread", replicas=2))
+            for p in pods:
+                p.metadata.labels["app"] = "spread"
+                p.spec.affinity = Affinity(pod_anti_affinity=[
+                    {"label_selector": {"app": "spread"}}
+                ])
+            ctx.submit(pods)
+            assert ctx.wait_tasks_ready("spread", 2)
+            hosts = {p.spec.node_name for p in ctx.running_pods("spread")}
+            assert len(hosts) == 2
+
+    def test_pod_affinity_colocates(self):
+        """'Pod Affinity': a follower requiring affinity to a running
+        leader pod lands on the leader's node."""
+        with Context(nodes=2, node_cpu="4", node_mem="8Gi") as ctx:
+            leader = ctx.create_job(JobSpec(name="leader", replicas=1))
+            leader[0].metadata.labels["app"] = "leader"
+            ctx.submit(leader)
+            assert ctx.wait_tasks_ready("leader", 1)
+            leader_host = ctx.running_pods("leader")[0].spec.node_name
+
+            follower = ctx.create_job(JobSpec(name="follower", replicas=1))
+            follower[0].spec.affinity = Affinity(pod_affinity=[
+                {"label_selector": {"app": "leader"}}
+            ])
+            ctx.submit(follower)
+            assert ctx.wait_tasks_ready("follower", 1)
+            assert ctx.running_pods("follower")[0].spec.node_name == leader_host
+
     def test_host_ports_exclusive(self):
         """'Host Ports' (predicates.go:98): two pods wanting the same host
         port land on different nodes."""

@@ -34,6 +34,11 @@ def make_cache(**kwargs):
     )
 
 
+def req_resource():
+    from kube_batch_tpu.api import Resource
+    return Resource(milli_cpu=500, memory=256 * 2**20)
+
+
 def req(cpu="1", mem="1Gi"):
     return build_resource_list(cpu=cpu, memory=mem)
 
@@ -149,6 +154,60 @@ class TestSnapshot:
         cache_task = c.jobs["ns/pg1"].tasks[task.uid]
         assert cache_task.status == TaskStatus.PENDING
         assert c.nodes["n1"].idle.milli_cpu == 4000
+
+    def test_snapshot_mutation_detector(self):
+        """Cache-mutation tripwire (the analog of the client-go cache
+        mutation detector the reference enables in unit tests,
+        hack/make-rules/test.sh:26-28): aggressively mutate every
+        reachable aggregate of a snapshot — node vectors, task clones,
+        job aggregates, queue weights — and assert the cache's state is
+        bit-identical afterwards."""
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=2))
+        c.add_node(build_node("n1", build_resource_list(cpu="4", memory="4Gi")))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", queue="q1"))
+        c.add_pod(build_pod("ns", "p1", "n1", PodPhase.RUNNING, req(),
+                            group_name="pg1"))
+        c.add_pod(build_pod("ns", "p2", "", PodPhase.PENDING, req(),
+                            group_name="pg1"))
+
+        def fingerprint():
+            n = c.nodes["n1"]
+            j = c.jobs["ns/pg1"]
+            return (
+                n.idle.milli_cpu, n.idle.memory, n.used.milli_cpu,
+                n.releasing.milli_cpu, n.allocatable.milli_cpu,
+                sorted(n.tasks), n.state.phase,
+                j.total_request.milli_cpu, j.allocated.milli_cpu,
+                sorted(j.tasks),
+                {s: sorted(t) for s, t in j.task_status_index.items()},
+                c.queues["q1"].weight,
+            )
+
+        before = fingerprint()
+        snap = c.snapshot()
+        node = snap.nodes["n1"]
+        node.idle.sub(req_resource())
+        node.used.add(req_resource())
+        node.releasing.add(req_resource())
+        node.allocatable.milli_cpu = 0
+        node.state.phase = "NotReady"
+        for t in node.tasks.values():
+            t.status = TaskStatus.RELEASING
+            t.resreq.milli_cpu = 99999
+        job = snap.jobs["ns/pg1"]
+        job.total_request.add(req_resource())
+        job.allocated.add(req_resource())
+        pending = [
+            t for t in job.tasks.values()
+            if t.status == TaskStatus.PENDING
+        ]
+        job.update_task_status(pending[0], TaskStatus.ALLOCATED)
+        for t in job.tasks.values():
+            t.resreq.scalar_resources = {"x": 1.0}
+        for q in snap.queues.values():
+            q.weight = 99
+        assert fingerprint() == before
 
     def test_snapshot_skips_not_ready_nodes_and_specless_jobs(self):
         c = make_cache()
