@@ -457,7 +457,7 @@ def _solve_round(
     *, task_req, task_fit, task_rank, task_queue, task_sel, task_ids,
     feas, static_score, fits_releasing, blocked_of,
     node_cap, node_max_tasks, queue_deserved,
-    lr_weight, br_weight, eps,
+    lr_weight, br_weight, eps, use_pallas=False,
 ):
     """ONE solver round, shared by solve / staged head / staged tail
     (same semantics on full or compacted task arrays):
@@ -483,24 +483,39 @@ def _solve_round(
     task_ok = (
         pending & task_sel & ~q_over[task_queue] & ~blocked_of(failed)
     )
-    fits = less_equal(task_fit[:, None, :], idle[None, :, :], eps)
     cap_ok = (node_max_tasks == 0) | (ntask < node_max_tasks)
-    mask = fits & feas & cap_ok[None, :] & task_ok[:, None]
-    failed = failed | (task_ok & ~jnp.any(mask, axis=1) & ~fits_releasing)
-    mask = mask & ~blocked_of(failed)[:, None]
-    score = (
-        dynamic_scores(task_req, idle, node_cap, lr_weight, br_weight)
-        + static_score
-    )
-    key = bid_keys(
-        score, task_ids[:, None], jnp.arange(N, dtype=jnp.int32)[None, :]
-    )
-    key = jnp.where(mask, key, -1)
-    bid = jnp.where(
-        jnp.any(mask, axis=1),
-        jnp.argmax(key, axis=1).astype(jnp.int32),
-        N,
-    )
+    if use_pallas:
+        # Fused tile-resident bid pass (pallas_kernels.py). Voiding the
+        # bids of newly job-blocked tasks afterwards is equivalent to
+        # re-masking their rows before the argmax.
+        from .pallas_kernels import pallas_bid
+
+        bid, any_feas = pallas_bid(
+            task_fit, task_req, task_ok, feas, idle, node_cap, cap_ok,
+            eps, lr_weight, br_weight,
+        )
+        failed = failed | (task_ok & ~any_feas & ~fits_releasing)
+        bid = jnp.where(blocked_of(failed), N, bid)
+    else:
+        fits = less_equal(task_fit[:, None, :], idle[None, :, :], eps)
+        mask = fits & feas & cap_ok[None, :] & task_ok[:, None]
+        failed = failed | (
+            task_ok & ~jnp.any(mask, axis=1) & ~fits_releasing
+        )
+        mask = mask & ~blocked_of(failed)[:, None]
+        score = (
+            dynamic_scores(task_req, idle, node_cap, lr_weight, br_weight)
+            + static_score
+        )
+        key = bid_keys(
+            score, task_ids[:, None], jnp.arange(N, dtype=jnp.int32)[None, :]
+        )
+        key = jnp.where(mask, key, -1)
+        bid = jnp.where(
+            jnp.any(mask, axis=1),
+            jnp.argmax(key, axis=1).astype(jnp.int32),
+            N,
+        )
     assigned, idle, ntask, qalloc, any_accept = _commit_bids(
         bid, assigned, idle, ntask, qalloc,
         task_req=task_req, task_fit=task_fit,
@@ -509,6 +524,23 @@ def _solve_round(
         queue_deserved=queue_deserved, eps=eps,
     )
     return assigned, idle, ntask, qalloc, failed, any_accept
+
+
+def _should_use_pallas(static_score, T: int) -> bool:
+    """Trace-time gate for the fused Pallas bid pass: opt-in via
+    KBT_PALLAS=1, TPU backend only, padded task axis, and no static score
+    rows (the kernel does not implement the sparse-row add)."""
+    from .pallas_kernels import TILE_T, pallas_enabled
+
+    if not pallas_enabled():
+        return False
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover
+        return False
+    return (
+        backend == "tpu" and static_score.ndim == 0 and T % TILE_T == 0
+    )
 
 
 def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
@@ -575,6 +607,7 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
         node_cap=inputs.node_cap, node_max_tasks=inputs.node_max_tasks,
         queue_deserved=inputs.queue_deserved,
         lr_weight=inputs.lr_weight, br_weight=inputs.br_weight, eps=eps,
+        use_pallas=_should_use_pallas(static_score, T),
     )
 
     def body(state):
@@ -677,6 +710,9 @@ def solve_staged(
         task_sel=inputs.task_valid, task_ids=arange_t,
         feas=feas0, static_score=static_score,
         fits_releasing=fits_releasing, blocked_of=job_blocked,
+        # The tail stays on the jnp path: its bid-key hash uses GLOBAL
+        # task ids (idxs) while the kernel hashes row positions.
+        use_pallas=_should_use_pallas(static_score, T),
         **shared_kw,
     )
 

@@ -1,0 +1,183 @@
+"""Pallas TPU kernel for the solver's fused bid pass.
+
+One solver round's [T, N] work (kernels._solve_round steps 2-4) is a chain
+of elementwise/broadcast ops ending in a row argmax: epsilon fit against
+idle, static mask AND, LeastRequested+Balanced scores, integer bid keys,
+argmax. Under plain XLA several [T, N] intermediates (mask, score, key)
+round-trip HBM; this kernel computes the whole chain tile-by-tile in VMEM
+and writes only the [T] bid/any-feasible vectors — HBM traffic drops to
+one read of the [T, N] static mask plus the small columnar inputs.
+
+Node tables (idle/cap, [N, R] f32) are small enough to sit in VMEM whole
+(5k nodes x 8 dims = 160 KB), so the grid is 1-D over task tiles.
+
+Gated behind ``KBT_PALLAS=1`` (or the ``use_pallas`` argument) until
+profiled on hardware; the jnp path in kernels.py stays the reference
+semantics, and tests assert bit-identical bids (interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .kernels import _KEY_BIAS, _KEY_HASH_BITS, MAX_PRIORITY, SCORE_QUANTUM
+
+TILE_T = 128
+
+# jax.experimental.pallas registers TPU lowerings at import; under the
+# CPU-only test harness (which purges non-CPU PJRT factories) that import
+# can fail — keep it lazy so merely importing this module never requires
+# a TPU-capable jaxlib.
+def _pl():
+    from jax.experimental import pallas as pl
+    return pl
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("KBT_PALLAS", "") == "1"
+
+
+def _bid_kernel(
+    pl,
+    fit_ref,      # f32[TILE_T, R]
+    req_ref,      # f32[TILE_T, R]
+    task_ok_ref,  # bool[TILE_T, 1]
+    feas_ref,     # bool[TILE_T, N]
+    idle_ref,     # f32[N, R]
+    cap_ref,      # f32[N, R]
+    cap_ok_ref,   # bool[1, N]
+    misc_ref,     # f32[1, R + 2] eps, lr_w, br_w
+    bid_ref,      # i32[TILE_T, 1] out
+    any_ref,      # bool[TILE_T, 1] out
+    *,
+    R: int,
+    N: int,
+):
+    idle = idle_ref[:]                                   # [N, R]
+    cap = cap_ref[:]
+
+    # Epsilon fit (resource_info.go:253-277), unrolled over the static R.
+    fits = jnp.ones((TILE_T, N), dtype=jnp.bool_)
+    for d in range(R):
+        eps_d = misc_ref[0, d]
+        fits = fits & (
+            fit_ref[:, d][:, None] - idle[:, d][None, :] < eps_d
+        )
+
+    mask = (
+        fits
+        & feas_ref[:]
+        & cap_ok_ref[0, :][None, :]
+        & task_ok_ref[:, 0][:, None]
+    )
+
+    # LeastRequested + Balanced (nodeorder.py formulas) on cpu/mem dims.
+    lr_w = misc_ref[0, R]
+    br_w = misc_ref[0, R + 1]
+    cap_cpu = cap[:, 0][None, :]
+    cap_mem = cap[:, 1][None, :]
+    rem_cpu = idle[:, 0][None, :] - req_ref[:, 0][:, None]   # [TILE_T, N]
+    rem_mem = idle[:, 1][None, :] - req_ref[:, 1][:, None]
+    safe_cpu = jnp.where(cap_cpu > 0, cap_cpu, 1.0)
+    safe_mem = jnp.where(cap_mem > 0, cap_mem, 1.0)
+    lr = 0.5 * (
+        jnp.where(
+            cap_cpu > 0,
+            jnp.maximum(rem_cpu, 0.0) * MAX_PRIORITY / safe_cpu,
+            0.0,
+        )
+        + jnp.where(
+            cap_mem > 0,
+            jnp.maximum(rem_mem, 0.0) * MAX_PRIORITY / safe_mem,
+            0.0,
+        )
+    )
+    frac_cpu = jnp.where(cap_cpu > 0, 1.0 - rem_cpu / safe_cpu, 1.0)
+    frac_mem = jnp.where(cap_mem > 0, 1.0 - rem_mem / safe_mem, 1.0)
+    br = jnp.where(
+        (frac_cpu >= 1.0) | (frac_mem >= 1.0),
+        0.0,
+        MAX_PRIORITY - jnp.abs(frac_cpu - frac_mem) * MAX_PRIORITY,
+    )
+    score = lr_w * lr + br_w * br
+
+    # Integer bid keys (kernels.bid_keys semantics, inlined).
+    t_ids = (
+        pl.program_id(0) * TILE_T
+        + jax.lax.broadcasted_iota(jnp.int32, (TILE_T, N), 0)
+    ).astype(jnp.uint32)
+    n_ids = jax.lax.broadcasted_iota(
+        jnp.int32, (TILE_T, N), 1
+    ).astype(jnp.uint32)
+    x = t_ids * jnp.uint32(2654435761) ^ (n_ids * jnp.uint32(0x9E3779B9))
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(2246822519)
+    h = ((x >> 8) & jnp.uint32((1 << _KEY_HASH_BITS) - 1)).astype(jnp.int32)
+    q = jnp.clip(
+        jnp.round(score / SCORE_QUANTUM) + _KEY_BIAS, 0, (1 << 20) - 1
+    ).astype(jnp.int32)
+    key = jnp.where(mask, (q << _KEY_HASH_BITS) | h, -1)
+
+    bid_ref[:] = jnp.argmax(key, axis=1).astype(jnp.int32)[:, None]
+    any_ref[:] = jnp.any(mask, axis=1)[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_bid(
+    task_fit,   # f32[T, R]
+    task_req,   # f32[T, R]
+    task_ok,    # bool[T]
+    feas,       # bool[T, N]
+    idle,       # f32[N, R]
+    cap,        # f32[N, R]
+    cap_ok,     # bool[N]
+    eps,        # f32[R]
+    lr_weight,  # f32[]
+    br_weight,  # f32[]
+    interpret: bool = False,
+):
+    """Fused mask+score+key+argmax; returns (bid i32[T], any_feas bool[T])
+    with bid == N for tasks with no feasible node."""
+    T, R = task_fit.shape
+    N = idle.shape[0]
+    assert T % TILE_T == 0, f"task axis {T} must be padded to {TILE_T}"
+    misc = jnp.concatenate(
+        [eps, lr_weight[None], br_weight[None]]
+    ).astype(jnp.float32)[None, :]
+
+    pl = _pl()
+    grid = (T // TILE_T,)
+    kernel = functools.partial(_bid_kernel, pl, R=R, N=N)
+    bid, any_feas = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_T, R), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_T, R), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_T, N), lambda i: (i, 0)),
+            pl.BlockSpec((N, R), lambda i: (0, 0)),
+            pl.BlockSpec((N, R), lambda i: (0, 0)),
+            pl.BlockSpec((1, N), lambda i: (0, 0)),
+            pl.BlockSpec((1, R + 2), lambda i: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((T, 1), jnp.int32),
+            jax.ShapeDtypeStruct((T, 1), jnp.bool_),
+        ),
+        interpret=interpret,
+    )(
+        task_fit, task_req, task_ok[:, None], feas,
+        idle, cap, cap_ok[None, :], misc,
+    )
+    bid = bid[:, 0]
+    any_feas = any_feas[:, 0]
+    return jnp.where(any_feas, bid, N), any_feas

@@ -1,0 +1,90 @@
+"""Pallas fused-bid kernel parity (solver/pallas_kernels.py).
+
+Runs the kernel in interpret mode (CPU) and asserts bit-identical bids
+against the reference jnp chain from kernels._solve_round."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kube_batch_tpu.solver.kernels import bid_keys, dynamic_scores, less_equal
+from kube_batch_tpu.solver.pallas_kernels import TILE_T, pallas_bid
+
+try:  # pallas import may be unavailable under the purged CPU harness
+    from jax.experimental import pallas as _pl  # noqa: F401
+    HAVE_PALLAS = True
+except Exception:
+    HAVE_PALLAS = False
+
+pytestmark = pytest.mark.skipif(
+    not HAVE_PALLAS, reason="pallas unavailable in this jax build"
+)
+
+
+def jnp_reference_bid(task_fit, task_req, task_ok, feas, idle, cap, cap_ok,
+                      eps, lr_w, br_w):
+    T = task_fit.shape[0]
+    N = idle.shape[0]
+    fits = less_equal(task_fit[:, None, :], idle[None, :, :], eps)
+    mask = fits & feas & cap_ok[None, :] & task_ok[:, None]
+    score = dynamic_scores(task_req, idle, cap, lr_w, br_w)
+    key = bid_keys(
+        score,
+        jnp.arange(T, dtype=jnp.int32)[:, None],
+        jnp.arange(N, dtype=jnp.int32)[None, :],
+    )
+    key = jnp.where(mask, key, -1)
+    any_feas = jnp.any(mask, axis=1)
+    bid = jnp.where(
+        any_feas, jnp.argmax(key, axis=1).astype(jnp.int32), N
+    )
+    return bid, any_feas
+
+
+def _random_case(seed, T, N, R=3):
+    rng = np.random.RandomState(seed)
+    task_req = rng.uniform(100, 3000, (T, R)).astype(np.float32)
+    task_fit = task_req * rng.uniform(1.0, 1.2, (T, 1)).astype(np.float32)
+    idle = rng.uniform(500, 32000, (N, R)).astype(np.float32)
+    cap = idle * rng.uniform(1.0, 1.5, (N, 1)).astype(np.float32)
+    return dict(
+        task_fit=jnp.asarray(task_fit),
+        task_req=jnp.asarray(task_req),
+        task_ok=jnp.asarray(rng.rand(T) > 0.1),
+        feas=jnp.asarray(rng.rand(T, N) > 0.2),
+        idle=jnp.asarray(idle),
+        cap=jnp.asarray(cap),
+        cap_ok=jnp.asarray(rng.rand(N) > 0.1),
+        eps=jnp.asarray([10.0] * R, jnp.float32),
+        lr_w=jnp.asarray(1.0, jnp.float32),
+        br_w=jnp.asarray(1.0, jnp.float32),
+    )
+
+
+def test_pallas_bid_matches_jnp_chain():
+    for seed in (0, 1, 2):
+        case = _random_case(seed, T=2 * TILE_T, N=256)
+        bid_p, any_p = pallas_bid(
+            case["task_fit"], case["task_req"], case["task_ok"],
+            case["feas"], case["idle"], case["cap"], case["cap_ok"],
+            case["eps"], case["lr_w"], case["br_w"], interpret=True,
+        )
+        bid_j, any_j = jnp_reference_bid(
+            case["task_fit"], case["task_req"], case["task_ok"],
+            case["feas"], case["idle"], case["cap"], case["cap_ok"],
+            case["eps"], case["lr_w"], case["br_w"],
+        )
+        np.testing.assert_array_equal(np.asarray(any_p), np.asarray(any_j))
+        np.testing.assert_array_equal(np.asarray(bid_p), np.asarray(bid_j))
+
+
+def test_pallas_bid_all_infeasible_column():
+    case = _random_case(5, T=TILE_T, N=128)
+    case["cap_ok"] = jnp.zeros(128, bool)
+    bid_p, any_p = pallas_bid(
+        case["task_fit"], case["task_req"], case["task_ok"],
+        case["feas"], case["idle"], case["cap"], case["cap_ok"],
+        case["eps"], case["lr_w"], case["br_w"], interpret=True,
+    )
+    assert not bool(np.asarray(any_p).any())
+    assert (np.asarray(bid_p) == 128).all()
