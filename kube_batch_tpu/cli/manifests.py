@@ -1,0 +1,277 @@
+"""k8s-manifest compatibility: load kube-batch CRD YAML directly.
+
+A kube-batch user's existing manifests — PodGroup and Queue under API
+group ``scheduling.incubator.k8s.io`` in either ``v1alpha1`` or
+``v1alpha2`` (the reference ships both versions with identical schemas,
+pkg/apis/scheduling/{v1alpha1,v1alpha2}/types.go; see config/crds/*.yaml
+and example/job.yaml), plus core ``v1`` Pod/Node/PriorityClass — load
+straight into the in-process cluster. This is the user-facing API surface
+of the reference (SURVEY.md §2 row 25); the generated clientset/informers
+(row 26) have no standalone analog beyond the ClusterAPI watch contract.
+
+Multi-document YAML is supported; unknown kinds raise.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+import yaml
+
+from ..api import GROUP_NAME_ANNOTATION_KEY, PodPhase, PriorityClass
+from ..api.objects import (
+    Affinity,
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodSpec,
+    Queue,
+    QueueSpec,
+    Taint,
+    Toleration,
+)
+from ..cluster import InProcessCluster
+
+SCHEDULING_GROUP = "scheduling.incubator.k8s.io"
+SUPPORTED_VERSIONS = ("v1alpha1", "v1alpha2")
+
+
+def _meta(doc: dict) -> ObjectMeta:
+    m = doc.get("metadata", {}) or {}
+    return ObjectMeta(
+        name=m.get("name", ""),
+        namespace=m.get("namespace", ""),
+        uid=m.get("uid", "") or f"{m.get('namespace', '')}-{m.get('name', '')}",
+        labels=dict(m.get("labels", {}) or {}),
+        annotations=dict(m.get("annotations", {}) or {}),
+    )
+
+
+def _resource_list(d) -> dict:
+    return {str(k): str(v) for k, v in (d or {}).items()}
+
+
+def _pod_group(doc: dict) -> PodGroup:
+    spec = doc.get("spec", {}) or {}
+    return PodGroup(
+        metadata=_meta(doc),
+        spec=PodGroupSpec(
+            min_member=int(spec.get("minMember", 1)),
+            queue=spec.get("queue", ""),
+            priority_class_name=spec.get("priorityClassName", ""),
+        ),
+    )
+
+
+def _queue(doc: dict) -> Queue:
+    spec = doc.get("spec", {}) or {}
+    capability = spec.get("capability")
+    return Queue(
+        metadata=_meta(doc),
+        spec=QueueSpec(
+            weight=int(spec.get("weight", 1)),
+            capability=_resource_list(capability) if capability else None,
+        ),
+    )
+
+
+def _toleration(t: dict) -> Toleration:
+    return Toleration(
+        key=t.get("key", ""),
+        operator=t.get("operator", "Equal"),
+        value=str(t.get("value", "")),
+        effect=t.get("effect", ""),
+    )
+
+
+def _affinity(a: dict) -> Affinity:
+    node_req = None
+    node_pref = None
+    node_aff = (a or {}).get("nodeAffinity") or {}
+    required = node_aff.get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ) or {}
+    terms = required.get("nodeSelectorTerms") or []
+    if terms:
+        node_req = [
+            {
+                "key": e.get("key"),
+                "operator": e.get("operator", "In"),
+                "values": list(e.get("values") or []),
+            }
+            for t in terms
+            for e in t.get("matchExpressions", []) or []
+        ]
+    preferred = node_aff.get(
+        "preferredDuringSchedulingIgnoredDuringExecution"
+    ) or []
+    if preferred:
+        node_pref = [
+            {
+                "weight": p.get("weight", 1),
+                "expressions": [
+                    {
+                        "key": e.get("key"),
+                        "operator": e.get("operator", "In"),
+                        "values": list(e.get("values") or []),
+                    }
+                    for e in (p.get("preference", {}) or {}).get(
+                        "matchExpressions", []
+                    ) or []
+                ],
+            }
+            for p in preferred
+        ]
+
+    def _pod_terms(section: str):
+        sec = (a or {}).get(section) or {}
+        req = sec.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+        out = []
+        for term in req:
+            sel = (term.get("labelSelector", {}) or {}).get(
+                "matchLabels", {}
+            ) or {}
+            out.append({"label_selector": dict(sel)})
+        return out or None
+
+    return Affinity(
+        node_required=node_req,
+        node_preferred=node_pref,
+        pod_affinity=_pod_terms("podAffinity"),
+        pod_anti_affinity=_pod_terms("podAntiAffinity"),
+    )
+
+
+def _pod(doc: dict) -> Pod:
+    spec = doc.get("spec", {}) or {}
+    status = doc.get("status", {}) or {}
+    containers = []
+    ports: List[int] = []
+    for c in spec.get("containers", []) or []:
+        requests = (
+            (c.get("resources", {}) or {}).get("requests", {}) or {}
+        )
+        cports = [
+            int(p.get("hostPort"))
+            for p in c.get("ports", []) or []
+            if p.get("hostPort")
+        ]
+        containers.append(Container(
+            name=c.get("name", "main"),
+            requests=_resource_list(requests),
+            ports=cports,
+        ))
+        ports.extend(cports)
+    affinity = spec.get("affinity")
+    pod = Pod(
+        metadata=_meta(doc),
+        spec=PodSpec(
+            node_name=spec.get("nodeName", ""),
+            node_selector=dict(spec.get("nodeSelector", {}) or {}),
+            affinity=_affinity(affinity) if affinity else None,
+            tolerations=[
+                _toleration(t) for t in spec.get("tolerations", []) or []
+            ],
+            containers=containers or [Container()],
+            priority=spec.get("priority"),
+            scheduler_name=spec.get(
+                "schedulerName", PodSpec().scheduler_name
+            ),
+        ),
+    )
+    pod.status.phase = status.get("phase", PodPhase.PENDING)
+    return pod
+
+
+def _node(doc: dict) -> Node:
+    status = doc.get("status", {}) or {}
+    spec = doc.get("spec", {}) or {}
+    allocatable = _resource_list(
+        status.get("allocatable") or status.get("capacity")
+    )
+    capacity = _resource_list(status.get("capacity") or allocatable)
+    node = Node(
+        metadata=_meta(doc),
+        status=NodeStatus(allocatable=allocatable, capacity=capacity),
+    )
+    node.spec.unschedulable = bool(spec.get("unschedulable", False))
+    node.spec.taints = [
+        Taint(
+            key=t.get("key", ""),
+            value=str(t.get("value", "")),
+            effect=t.get("effect", ""),
+        )
+        for t in spec.get("taints", []) or []
+    ]
+    return node
+
+
+def _priority_class(doc: dict) -> PriorityClass:
+    return PriorityClass(
+        metadata=_meta(doc),
+        value=int(doc.get("value", 0)),
+        global_default=bool(doc.get("globalDefault", False)),
+    )
+
+
+def parse_manifest(doc: dict) -> Tuple[str, object]:
+    """(cluster kind, object) from one k8s manifest document."""
+    api_version = doc.get("apiVersion", "")
+    kind = doc.get("kind", "")
+    if "/" in api_version:
+        group, version = api_version.split("/", 1)
+    else:
+        group, version = "", api_version
+    if group == SCHEDULING_GROUP:
+        if version not in SUPPORTED_VERSIONS:
+            raise ValueError(
+                f"unsupported {SCHEDULING_GROUP} version {version!r} "
+                f"(supported: {SUPPORTED_VERSIONS})"
+            )
+        if kind == "PodGroup":
+            return "PodGroup", _pod_group(doc)
+        if kind == "Queue":
+            return "Queue", _queue(doc)
+        raise ValueError(f"unknown kind {kind!r} in group {group}")
+    if group in ("", "v1") or api_version == "v1":
+        if kind == "Pod":
+            return "Pod", _pod(doc)
+        if kind == "Node":
+            return "Node", _node(doc)
+        if kind == "PriorityClass":
+            return "PriorityClass", _priority_class(doc)
+    if group == "scheduling.k8s.io" and kind == "PriorityClass":
+        return "PriorityClass", _priority_class(doc)
+    raise ValueError(f"unsupported manifest {api_version!r} kind {kind!r}")
+
+
+def apply_manifests(cluster: InProcessCluster, docs: Iterable[dict]) -> int:
+    """Create every manifest object in the cluster; returns the count."""
+    n = 0
+    for doc in docs:
+        if not doc:
+            continue
+        kind, obj = parse_manifest(doc)
+        cluster.create(kind, obj)
+        n += 1
+    return n
+
+
+def load_manifest_file(cluster: InProcessCluster, path: str) -> int:
+    with open(path) as f:
+        return apply_manifests(cluster, yaml.safe_load_all(f))
+
+
+# Convenience: the group-name annotation a Pod uses to join a PodGroup
+# (reference labels.go:21, read by job_info).
+__all__ = [
+    "GROUP_NAME_ANNOTATION_KEY",
+    "SCHEDULING_GROUP",
+    "apply_manifests",
+    "load_manifest_file",
+    "parse_manifest",
+]

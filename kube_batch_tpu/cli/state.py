@@ -59,8 +59,20 @@ def _resource_list(d):
 
 
 def load_cluster_state(path: str, simulate_kubelet: bool = True) -> InProcessCluster:
+    """Load either the compact schema above or standard k8s manifests.
+
+    Documents carrying ``apiVersion`` are treated as kube-batch CRD /
+    core-v1 manifests (cli/manifests.py) — a reference user's existing
+    YAML (example/job.yaml, config/queue/default.yaml) loads unchanged."""
     with open(path) as f:
-        data = yaml.safe_load(f) or {}
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    if docs and any("apiVersion" in d for d in docs):
+        from .manifests import apply_manifests
+
+        cluster = InProcessCluster(simulate_kubelet=simulate_kubelet)
+        apply_manifests(cluster, docs)
+        return cluster
+    data = docs[0] if docs else {}
     return build_cluster_from_dict(data, simulate_kubelet=simulate_kubelet)
 
 

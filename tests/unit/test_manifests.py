@@ -1,0 +1,121 @@
+"""k8s-manifest loader (cli/manifests.py): a kube-batch user's CRD YAML
+(PodGroup/Queue in scheduling.incubator.k8s.io v1alpha1 or v1alpha2, core
+v1 Pod/Node) must load and schedule end-to-end."""
+
+import threading
+import time
+
+import pytest
+import yaml
+
+from kube_batch_tpu.api import GROUP_NAME_ANNOTATION_KEY, PodPhase
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.cli.manifests import apply_manifests, parse_manifest
+from kube_batch_tpu.cluster import InProcessCluster
+from kube_batch_tpu.scheduler import Scheduler
+
+MANIFESTS = f"""
+apiVersion: scheduling.incubator.k8s.io/v1alpha1
+kind: Queue
+metadata:
+  name: default
+spec:
+  weight: 4
+---
+apiVersion: scheduling.incubator.k8s.io/v1alpha2
+kind: PodGroup
+metadata:
+  name: qj-1
+  namespace: default
+spec:
+  minMember: 2
+  queue: default
+---
+apiVersion: v1
+kind: Node
+metadata:
+  name: node-a
+  labels: {{zone: a}}
+status:
+  allocatable: {{cpu: "4", memory: 8Gi, pods: "20"}}
+  capacity: {{cpu: "4", memory: 8Gi, pods: "20"}}
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: qj-1-0
+  namespace: default
+  annotations:
+    {GROUP_NAME_ANNOTATION_KEY}: qj-1
+spec:
+  containers:
+  - name: main
+    resources:
+      requests: {{cpu: 500m, memory: 256Mi}}
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: qj-1-1
+  namespace: default
+  annotations:
+    {GROUP_NAME_ANNOTATION_KEY}: qj-1
+spec:
+  tolerations:
+  - key: dedicated
+    operator: Equal
+    value: ml
+    effect: NoSchedule
+  containers:
+  - name: main
+    resources:
+      requests: {{cpu: 500m, memory: 256Mi}}
+"""
+
+
+def test_both_crd_versions_parse():
+    docs = list(yaml.safe_load_all(MANIFESTS))
+    kinds = [parse_manifest(d)[0] for d in docs]
+    assert kinds == ["Queue", "PodGroup", "Node", "Pod", "Pod"]
+    _, queue = parse_manifest(docs[0])
+    assert queue.spec.weight == 4
+    _, pg = parse_manifest(docs[1])
+    assert pg.spec.min_member == 2
+    _, pod = parse_manifest(docs[4])
+    assert pod.spec.tolerations[0].value == "ml"
+    assert pod.metadata.annotations[GROUP_NAME_ANNOTATION_KEY] == "qj-1"
+
+
+def test_unsupported_version_rejected():
+    with pytest.raises(ValueError, match="unsupported"):
+        parse_manifest({
+            "apiVersion": "scheduling.incubator.k8s.io/v1beta1",
+            "kind": "PodGroup",
+        })
+
+
+def test_manifests_schedule_end_to_end():
+    cluster = InProcessCluster(simulate_kubelet=True)
+    n = apply_manifests(cluster, yaml.safe_load_all(MANIFESTS))
+    assert n == 5
+    cache = SchedulerCache(cluster=cluster)
+    sched = Scheduler(cache, schedule_period=0.05)
+    stop = threading.Event()
+    t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    t.start()
+    deadline = time.time() + 20
+    done = False
+    while time.time() < deadline:
+        pods = cluster.list_objects("Pod")
+        if all(p.status.phase == PodPhase.RUNNING for p in pods):
+            done = True
+            break
+        time.sleep(0.05)
+    stop.set()
+    t.join(timeout=5)
+    assert done, [
+        (p.metadata.name, p.status.phase, p.spec.node_name)
+        for p in cluster.list_objects("Pod")
+    ]
+    for p in cluster.list_objects("Pod"):
+        assert p.spec.node_name == "node-a"
