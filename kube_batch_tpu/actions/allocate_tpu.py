@@ -27,7 +27,7 @@ import logging
 import numpy as np
 
 from ..framework import Action, register_action
-from ..solver import solve_jit, tensorize
+from ..solver import solve_sharded, tensorize
 from ..utils.scheduler_helper import prioritize_nodes, select_best_node
 
 logger = logging.getLogger(__name__)
@@ -45,7 +45,10 @@ class AllocateTpuAction(Action):
         if inputs is None:
             return
 
-        result = solve_jit(inputs, max_rounds=self.max_rounds)
+        # solve_sharded shards the node axis over all visible devices
+        # (the multi-chip scale path) and falls back to the cached
+        # single-device jit when only one device exists.
+        result = solve_sharded(inputs, max_rounds=self.max_rounds)
         assigned = np.asarray(result.assigned)
 
         placed = 0

@@ -24,6 +24,13 @@ from .kernels import (
     solve_staged_jit,
 )
 from .masks import BatchMask, CombinedMask, combine_masks, combine_score_rows
+from .sharding import (
+    default_mesh,
+    pad_nodes,
+    sharded_step,
+    shardings_for,
+    solve_sharded,
+)
 from .snapshot import ResourceLayout, SnapshotContext, tensorize
 
 __all__ = [
@@ -38,14 +45,19 @@ __all__ = [
     "build_static_score",
     "combine_masks",
     "combine_score_rows",
+    "default_mesh",
     "dynamic_scores",
     "less_equal",
     "make_inputs",
+    "pad_nodes",
     "segmented_cumsum",
+    "sharded_step",
+    "shardings_for",
     "solve",
     "solve_auto",
     "solve_full_jit",
     "solve_jit",
+    "solve_sharded",
     "solve_staged",
     "solve_staged_jit",
     "tensorize",

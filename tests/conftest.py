@@ -1,33 +1,18 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any ``import jax`` so multi-chip sharding paths can be
+Must run before any backend resolution so multi-chip sharding paths can be
 exercised without TPU hardware (the driver separately dry-runs the real
-multi-chip path via __graft_entry__.dryrun_multichip).
+multi-chip path via __graft_entry__.dryrun_multichip). The heavy lifting —
+dropping the site-injected TPU-tunnel PJRT factory before it can dial a
+possibly-wedged tunnel, and growing XLA_FLAGS' host device count — lives in
+kube_batch_tpu.utils.backend.force_cpu_devices, shared with the entry
+points.
 """
 
-import os
+from kube_batch_tpu.utils.backend import force_cpu_devices
 
-# Force CPU even when the environment preselects a TPU platform (e.g.
-# JAX_PLATFORMS=axon): unit/e2e tests must be hardware-independent; the
-# benchmark harness and the driver's dryrun use the real platform.
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-# A site-injected PJRT plugin (tunneled TPU) may already be registered by
-# sitecustomize before this conftest runs; jax initializes every registered
-# factory during backend discovery, so JAX_PLATFORMS=cpu alone does not stop
-# it from dialing the (possibly unreachable) tunnel and hanging the whole
-# test run. Drop every non-CPU factory before the first backend resolution.
-import jax  # noqa: E402
-import jax._src.xla_bridge as _xb  # noqa: E402
-
-for _name in [n for n in _xb._backend_factories if n != "cpu"]:
-    del _xb._backend_factories[_name]
-
-# sitecustomize may have imported jax at interpreter start, freezing the
-# platform config from the pre-override environment; update it explicitly.
-jax.config.update("jax_platforms", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if not force_cpu_devices(8):
+    raise RuntimeError(
+        "tests need an 8-device virtual CPU mesh, but a jax backend with "
+        "fewer devices was already initialized before conftest ran"
+    )
