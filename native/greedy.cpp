@@ -158,4 +158,151 @@ int64_t greedy_allocate(const float* task_req,      // [T, R]
   return placed;
 }
 
+// Feasibility-aware greedy allocate: the production CPU fallback.
+//
+// Same sequential loop as greedy_allocate, but consuming the FULL
+// factorized snapshot the TPU solver consumes (solver/kernels.py
+// SolverInputs): per-task predicate rows (group/pair factorization,
+// masks.py), node pod-count caps (predicates.py MaxTaskNum), the
+// fit-vs-subtract resreq split (job_info.go InitResreq vs Resreq), static
+// affinity score rows, and the reference's job-break semantics
+// (allocate.go:144-148: first no-feasible-node verdict skips the rest of
+// that job for the cycle). Indices in out_assign refer to the UNfiltered
+// node table, so the caller can map straight back to ctx.nodes.
+//
+// pair_idx and score_idx must be ascending (tensorize emits them sorted);
+// tasks are processed in ascending index order = global priority order.
+int64_t greedy_allocate_masked(
+    const float* task_req,        // [T, R] subtracted on allocate
+    const float* task_fit,        // [T, R] fit-checked (init resreq)
+    const int32_t* task_queue,    // [T]
+    const int32_t* task_job,      // [T]
+    const uint8_t* task_valid,    // [T]
+    const int32_t* task_group,    // [T] feasibility group
+    const uint8_t* node_feas,     // [N] node-level predicate column
+    const uint8_t* group_feas,    // [G, N]
+    const int32_t* pair_idx,      // [P] ascending
+    const uint8_t* pair_feas,     // [P, N]
+    const int32_t* score_idx,     // [S] ascending
+    const float* score_rows,      // [S, N]
+    const float* node_idle0,      // [N, R]
+    const float* node_cap,        // [N, R]
+    const int32_t* node_task_count0,  // [N]
+    const int32_t* node_max_tasks,    // [N] 0 = unlimited
+    const float* queue_deserved,  // [Q, R]
+    const float* queue_alloc0,    // [Q, R]
+    const float* eps,             // [R]
+    double lr_w, double br_w,
+    int64_t T, int64_t N, int64_t Q, int64_t R,
+    int64_t G, int64_t P, int64_t S,
+    int32_t* out_assign) {
+  std::vector<float> idle(node_idle0, node_idle0 + N * R);
+  std::vector<float> qalloc(queue_alloc0, queue_alloc0 + Q * R);
+  std::vector<int32_t> ntask(node_task_count0, node_task_count0 + N);
+  std::vector<uint8_t> job_failed(T, 0);  // task_job is a dense index < T
+  int64_t placed = 0;
+  int64_t pcur = 0, scur = 0;
+
+  for (int64_t t = 0; t < T; ++t) {
+    out_assign[t] = -1;
+    // Advance the sparse-row cursors regardless of skips below so they
+    // stay aligned with ascending t.
+    while (pcur < P && pair_idx[pcur] < t) ++pcur;
+    while (scur < S && score_idx[scur] < t) ++scur;
+    const uint8_t* prow =
+        (pcur < P && pair_idx[pcur] == t) ? pair_feas + pcur * N : nullptr;
+    const float* srow =
+        (scur < S && score_idx[scur] == t) ? score_rows + scur * N : nullptr;
+
+    if (!task_valid[t]) continue;
+    const int64_t j = task_job[t];
+    if (j >= 0 && j < T && job_failed[j]) continue;  // allocate.go:144-148
+    const float* req = task_req + t * R;
+    const float* fit = task_fit + t * R;
+    const int64_t q = task_queue[t];
+    if (q >= 0 && q < Q &&
+        overused(queue_deserved + q * R, qalloc.data() + q * R, eps, R)) {
+      continue;  // allocate.go:94-95
+    }
+    const uint8_t* grow =
+        (task_group[t] >= 0 && task_group[t] < G)
+            ? group_feas + task_group[t] * N
+            : nullptr;
+
+    int64_t best = -1;
+    double best_score = -1.0e300;
+    bool any_feasible = false;
+#ifdef _OPENMP
+#pragma omp parallel
+    {
+      int64_t lbest = -1;
+      double lscore = -1.0e300;
+      bool lfeas = false;
+#pragma omp for nowait
+      for (int64_t n = 0; n < N; ++n) {
+        if (!node_feas[n]) continue;
+        if (grow && !grow[n]) continue;
+        if (prow && !prow[n]) continue;
+        if (node_max_tasks[n] > 0 && ntask[n] >= node_max_tasks[n]) continue;
+        lfeas = true;
+        if (!fits(fit, idle.data() + n * R, eps, R)) continue;
+        double s = score(req, idle.data() + n * R, node_cap + n * R,
+                         lr_w, br_w);
+        if (srow) s += srow[n];
+        if (s > lscore || (s == lscore && (lbest < 0 || n < lbest))) {
+          lscore = s;
+          lbest = n;
+        }
+      }
+#pragma omp critical
+      {
+        any_feasible = any_feasible || lfeas;
+        if (lbest >= 0 &&
+            (lscore > best_score ||
+             (lscore == best_score && (best < 0 || lbest < best)))) {
+          best_score = lscore;
+          best = lbest;
+        }
+      }
+    }
+#else
+    for (int64_t n = 0; n < N; ++n) {
+      if (!node_feas[n]) continue;
+      if (grow && !grow[n]) continue;
+      if (prow && !prow[n]) continue;
+      if (node_max_tasks[n] > 0 && ntask[n] >= node_max_tasks[n]) continue;
+      any_feasible = true;
+      if (!fits(fit, idle.data() + n * R, eps, R)) continue;
+      double s = score(req, idle.data() + n * R, node_cap + n * R,
+                       lr_w, br_w);
+      if (srow) s += srow[n];
+      if (s > best_score) {
+        best_score = s;
+        best = n;
+      }
+    }
+#endif
+
+    if (best < 0) {
+      // No node took the task. The job-break verdict applies only when
+      // NO node was predicate-feasible for the task at all; a task that
+      // merely failed the resource fit can still pipeline onto Releasing
+      // resources in the epilogue (solver job_blocked mirrors this via
+      // fits_releasing).
+      if (!any_feasible && j >= 0 && j < T) job_failed[j] = 1;
+      continue;
+    }
+    float* nidle = idle.data() + best * R;
+    for (int64_t d = 0; d < R; ++d) nidle[d] -= req[d];
+    ntask[best] += 1;
+    if (q >= 0 && q < Q) {
+      float* qa = qalloc.data() + q * R;
+      for (int64_t d = 0; d < R; ++d) qa[d] += req[d];
+    }
+    out_assign[t] = static_cast<int32_t>(best);
+    ++placed;
+  }
+  return placed;
+}
+
 }  // extern "C"
