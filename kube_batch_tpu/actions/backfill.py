@@ -23,7 +23,9 @@ class BackfillAction(Action):
                 job.task_status_index.get(TaskStatus.PENDING, {}).values()
             ):
                 if not task.init_resreq.is_empty():
-                    continue  # TODO parity: reference only backfills BestEffort
+                    # Reference parity: backfill only places tasks with an
+                    # EMPTY resource request (BestEffort), backfill.go:45-49.
+                    continue
                 for node in get_node_list(ssn.nodes):
                     try:
                         ssn.predicate_fn(task, node)

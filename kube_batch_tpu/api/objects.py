@@ -91,12 +91,14 @@ class Container:
 class Affinity:
     """Subset of k8s affinity the reference predicates/priorities evaluate."""
 
-    # node affinity: required = list of match-expression dicts
-    #   [{"key": ..., "operator": "In"|"NotIn"|"Exists"|"DoesNotExist", "values": [...]}]
-    node_required: Optional[List[Dict]] = None
+    # required node affinity: list of nodeSelectorTerms (OR across terms),
+    # each term a list of match-expression dicts (AND within a term). A
+    # flat expression list is accepted as shorthand for a single term.
+    node_required: Optional[List] = None
     node_preferred: Optional[List[Dict]] = None  # [{"weight": w, "expressions": [...]}]
-    # pod (anti-)affinity: label selectors over pods, topology key = node name
-    pod_affinity: Optional[List[Dict]] = None  # [{"label_selector": {...}}]
+    # pod (anti-)affinity: required terms over pod labels, topology = node
+    #   [{"label_selector": {k: v}, "match_expressions": [...]?}]
+    pod_affinity: Optional[List[Dict]] = None
     pod_anti_affinity: Optional[List[Dict]] = None
 
 

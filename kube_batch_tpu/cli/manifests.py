@@ -97,15 +97,26 @@ def _affinity(a: dict) -> Affinity:
     ) or {}
     terms = required.get("nodeSelectorTerms") or []
     if terms:
-        node_req = [
-            {
-                "key": e.get("key"),
-                "operator": e.get("operator", "In"),
-                "values": list(e.get("values") or []),
-            }
-            for t in terms
-            for e in t.get("matchExpressions", []) or []
-        ]
+        # Term structure is preserved: k8s ORs across nodeSelectorTerms and
+        # ANDs within a term's matchExpressions (vendored reference
+        # predicates nodeMatchesNodeSelectorTerms: "if one of the terms is
+        # satisfied"). Flattening would turn zone-a OR zone-b into an
+        # unsatisfiable conjunction.
+        node_req = []
+        for t in terms:
+            if t.get("matchFields"):
+                raise ValueError(
+                    "nodeSelectorTerms.matchFields is not supported; "
+                    "use matchExpressions"
+                )
+            node_req.append([
+                {
+                    "key": e.get("key"),
+                    "operator": e.get("operator", "In"),
+                    "values": list(e.get("values") or []),
+                }
+                for e in t.get("matchExpressions", []) or []
+            ])
     preferred = node_aff.get(
         "preferredDuringSchedulingIgnoredDuringExecution"
     ) or []
@@ -132,10 +143,36 @@ def _affinity(a: dict) -> Affinity:
         req = sec.get("requiredDuringSchedulingIgnoredDuringExecution") or []
         out = []
         for term in req:
-            sel = (term.get("labelSelector", {}) or {}).get(
-                "matchLabels", {}
-            ) or {}
-            out.append({"label_selector": dict(sel)})
+            topo = term.get("topologyKey", "kubernetes.io/hostname")
+            if topo != "kubernetes.io/hostname":
+                # The in-process evaluator's topology domain is the node
+                # (reference predicates.go:252-262 with node-level
+                # NodeInfo); a zone/rack key would silently change which
+                # pods count as co-located.
+                raise ValueError(
+                    f"unsupported {section} topologyKey {topo!r} "
+                    "(only kubernetes.io/hostname)"
+                )
+            sel = term.get("labelSelector", {}) or {}
+            unknown = set(sel) - {"matchLabels", "matchExpressions"}
+            if unknown:
+                raise ValueError(
+                    f"unsupported {section} labelSelector fields {sorted(unknown)}"
+                )
+            parsed = {
+                "label_selector": dict(sel.get("matchLabels", {}) or {})
+            }
+            exprs = sel.get("matchExpressions") or []
+            if exprs:
+                parsed["match_expressions"] = [
+                    {
+                        "key": e.get("key"),
+                        "operator": e.get("operator", "In"),
+                        "values": list(e.get("values") or []),
+                    }
+                    for e in exprs
+                ]
+            out.append(parsed)
         return out or None
 
     return Affinity(

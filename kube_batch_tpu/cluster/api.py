@@ -192,7 +192,13 @@ class InProcessCluster(ClusterAPI):
         while True:
             with self._lock:
                 if not self._kubelet_queue:
-                    return  # thread exits; next bind restarts it
+                    # Hand off under the lock: clearing _kubelet_thread
+                    # BEFORE the thread exits means a concurrent enqueue
+                    # cannot observe a dying-but-still-alive worker and
+                    # skip the restart (which would strand the final
+                    # Pending→Running flip until the next bind).
+                    self._kubelet_thread = None
+                    return
                 deadline, key = self._kubelet_queue[0]
             delay = deadline - time.monotonic()
             if delay > 0:

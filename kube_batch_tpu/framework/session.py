@@ -119,6 +119,19 @@ class Session:
         self.event_handlers = []
         self.job_order_fns = {}
         self.queue_order_fns = {}
+        self.task_order_fns = {}
+        self.predicate_fns = {}
+        self.batch_predicate_fns = {}
+        self.batch_task_order_key_fns = {}
+        self.preemptable_fns = {}
+        self.reclaimable_fns = {}
+        self.overused_fns = {}
+        self.job_ready_fns = {}
+        self.job_pipelined_fns = {}
+        self.job_valid_fns = {}
+        self.node_order_fns = {}
+        self.batch_node_order_fns = {}
+        self.queue_budget_fns = {}
 
     def _job_status(self, job: JobInfo):
         """Recompute PodGroup status (reference session.go:146-184)."""

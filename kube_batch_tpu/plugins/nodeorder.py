@@ -16,7 +16,11 @@ from __future__ import annotations
 
 from ..api import NodeInfo, TaskInfo
 from ..framework import Plugin, register_plugin_builder
-from .util import match_label_selector, match_node_selector_terms
+from .util import (
+    match_affinity_term,
+    match_label_selector,
+    match_node_selector_terms,
+)
 
 MAX_PRIORITY = 10.0
 
@@ -89,9 +93,9 @@ def make_inter_pod_affinity_score(ssn):
             return 0.0
         matched = 0
         for term in affinity.pod_affinity:
-            sel = term.get("label_selector", {})
             if any(
-                match_label_selector(sel, t.pod.metadata.labels) for t in on_node
+                match_affinity_term(term, t.pod.metadata.labels)
+                for t in on_node
             ):
                 matched += 1
         return matched * MAX_PRIORITY / len(affinity.pod_affinity)

@@ -32,6 +32,7 @@ from .util import (
     PLACED_STATUSES,
     PredicateError,
     SessionPodLister,
+    match_affinity_term,
     match_label_selector,
     match_node_selector_terms,
 )
@@ -156,9 +157,8 @@ class PredicatesPlugin(Plugin):
                 return
             on_node = lister.pods_on_node(node.name)
             for term in affinity.pod_affinity or []:
-                sel = term.get("label_selector", {})
                 if not any(
-                    match_label_selector(sel, t.pod.metadata.labels)
+                    match_affinity_term(term, t.pod.metadata.labels)
                     for t in on_node
                 ):
                     # k8s bootstrap rule (vendored predicates
@@ -167,21 +167,20 @@ class PredicatesPlugin(Plugin):
                     # pod itself matches the selector — the first pod of a
                     # self-affine group must be schedulable somewhere.
                     exists_anywhere = any(
-                        match_label_selector(sel, t.pod.metadata.labels)
+                        match_affinity_term(term, t.pod.metadata.labels)
                         for t in lister.tasks()
                         if t.uid != task.uid and t.status in PLACED_STATUSES
                     )
-                    if exists_anywhere or not match_label_selector(
-                        sel, task.pod.metadata.labels
+                    if exists_anywhere or not match_affinity_term(
+                        term, task.pod.metadata.labels
                     ):
                         raise PredicateError(
                             "MatchInterPodAffinity",
                             f"pod affinity not satisfied on {node.name}",
                         )
             for term in affinity.pod_anti_affinity or []:
-                sel = term.get("label_selector", {})
                 if any(
-                    match_label_selector(sel, t.pod.metadata.labels)
+                    match_affinity_term(term, t.pod.metadata.labels)
                     for t in on_node
                     if t.uid != task.uid
                 ):
@@ -272,13 +271,21 @@ class PredicatesPlugin(Plugin):
                 return sig
 
             def _terms_sig(terms):
+                # node_required is a list of terms (each a list of
+                # expression dicts), or a flat expression list treated as
+                # one term — mirror match_node_selector_terms.
+                if terms and isinstance(terms[0], dict):
+                    terms = [terms]
                 return tuple(
-                    (
-                        t.get("key"),
-                        t.get("operator"),
-                        tuple(t.get("values") or ()),
+                    tuple(
+                        (
+                            e.get("key"),
+                            e.get("operator"),
+                            tuple(e.get("values") or ()),
+                        )
+                        for e in term
                     )
-                    for t in terms
+                    for term in terms
                 )
 
             sig_to_group: dict = {}

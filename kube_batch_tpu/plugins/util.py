@@ -60,10 +60,8 @@ def match_label_selector(selector: Dict[str, str], labels: Dict[str, str]) -> bo
     return all(labels.get(k) == v for k, v in selector.items())
 
 
-def match_node_selector_terms(expressions: Optional[List[Dict]], labels: Dict[str, str]) -> bool:
-    """Evaluate node-affinity match expressions (In/NotIn/Exists/DoesNotExist)."""
-    if not expressions:
-        return True
+def match_expressions(expressions: List[Dict], labels: Dict[str, str]) -> bool:
+    """Conjunction of match expressions (In/NotIn/Exists/DoesNotExist)."""
     for expr in expressions:
         key = expr.get("key", "")
         op = expr.get("operator", "In")
@@ -83,3 +81,31 @@ def match_node_selector_terms(expressions: Optional[List[Dict]], labels: Dict[st
         else:
             return False
     return True
+
+
+def match_node_selector_terms(terms: Optional[List], labels: Dict[str, str]) -> bool:
+    """Evaluate required node affinity: a pod matches if ANY
+    nodeSelectorTerm is satisfied; expressions within one term are a
+    conjunction (k8s nodeMatchesNodeSelectorTerms, vendored by reference
+    predicates.go PodMatchNodeSelector).
+
+    ``terms`` is a list of terms, each term a list of match-expression
+    dicts. A flat list of expression dicts (the pre-term-structure
+    representation still used by direct Affinity constructors) is accepted
+    as a single term. An individual EMPTY term matches nothing (k8s: "a
+    null or empty nodeSelectorTerm matches no objects")."""
+    if not terms:
+        return True
+    if isinstance(terms[0], dict):  # flat: one term of expressions
+        terms = [terms]
+    return any(bool(term) and match_expressions(term, labels) for term in terms)
+
+
+def match_affinity_term(term: Dict, labels: Dict[str, str]) -> bool:
+    """One pod-(anti)affinity term against a pod's labels: matchLabels
+    (equality) AND matchExpressions (set ops) must both hold, per k8s
+    metav1.LabelSelector semantics."""
+    if not match_label_selector(term.get("label_selector", {}) or {}, labels):
+        return False
+    exprs = term.get("match_expressions") or []
+    return match_expressions(exprs, labels) if exprs else True

@@ -119,3 +119,108 @@ def test_manifests_schedule_end_to_end():
     ]
     for p in cluster.list_objects("Pod"):
         assert p.spec.node_name == "node-a"
+
+
+AFFINITY_POD = """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: aff-pod
+  namespace: default
+spec:
+  affinity:
+    nodeAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+        nodeSelectorTerms:
+        - matchExpressions:
+          - {key: zone, operator: In, values: [a]}
+        - matchExpressions:
+          - {key: zone, operator: In, values: [b]}
+  containers:
+  - name: main
+    resources:
+      requests: {cpu: 100m}
+"""
+
+
+def test_node_affinity_terms_or_semantics():
+    """k8s ORs across nodeSelectorTerms: a pod asking zone-a OR zone-b must
+    match a zone-b node (advisor finding: flattening made this an
+    unsatisfiable conjunction)."""
+    from kube_batch_tpu.plugins.util import match_node_selector_terms
+
+    _, pod = parse_manifest(yaml.safe_load(AFFINITY_POD))
+    terms = pod.spec.affinity.node_required
+    assert len(terms) == 2 and isinstance(terms[0], list)
+    assert match_node_selector_terms(terms, {"zone": "a"})
+    assert match_node_selector_terms(terms, {"zone": "b"})
+    assert not match_node_selector_terms(terms, {"zone": "c"})
+    # flat shorthand still accepted as a single conjunction term
+    flat = [{"key": "zone", "operator": "In", "values": ["a"]}]
+    assert match_node_selector_terms(flat, {"zone": "a"})
+    assert not match_node_selector_terms(flat, {"zone": "b"})
+
+
+def test_node_affinity_match_fields_rejected():
+    doc = yaml.safe_load(AFFINITY_POD)
+    terms = doc["spec"]["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ]["nodeSelectorTerms"]
+    terms[0]["matchFields"] = [
+        {"key": "metadata.name", "operator": "In", "values": ["n1"]}
+    ]
+    with pytest.raises(ValueError, match="matchFields"):
+        parse_manifest(doc)
+
+
+POD_AFFINITY_POD = """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: anti-pod
+  namespace: default
+spec:
+  affinity:
+    podAntiAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+      - topologyKey: kubernetes.io/hostname
+        labelSelector:
+          matchLabels: {app: web}
+          matchExpressions:
+          - {key: tier, operator: In, values: [frontend]}
+  containers:
+  - name: main
+    resources:
+      requests: {cpu: 100m}
+"""
+
+
+def test_pod_affinity_match_expressions_parsed():
+    """Advisor finding: matchExpressions were silently dropped, letting
+    must-spread pods co-locate. They are now parsed and evaluated."""
+    from kube_batch_tpu.plugins.util import match_affinity_term
+
+    _, pod = parse_manifest(yaml.safe_load(POD_AFFINITY_POD))
+    term = pod.spec.affinity.pod_anti_affinity[0]
+    assert term["match_expressions"][0]["key"] == "tier"
+    assert match_affinity_term(term, {"app": "web", "tier": "frontend"})
+    assert not match_affinity_term(term, {"app": "web", "tier": "backend"})
+    assert not match_affinity_term(term, {"tier": "frontend"})
+
+
+def test_pod_affinity_unsupported_topology_rejected():
+    doc = yaml.safe_load(POD_AFFINITY_POD)
+    doc["spec"]["affinity"]["podAntiAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ][0]["topologyKey"] = "topology.kubernetes.io/zone"
+    with pytest.raises(ValueError, match="topologyKey"):
+        parse_manifest(doc)
+
+
+def test_pod_affinity_unknown_selector_field_rejected():
+    doc = yaml.safe_load(POD_AFFINITY_POD)
+    doc["spec"]["affinity"]["podAntiAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ][0]["labelSelector"]["matchFoo"] = {}
+    with pytest.raises(ValueError, match="matchFoo"):
+        parse_manifest(doc)
