@@ -189,6 +189,25 @@ def bench_native_greedy(inputs, repeats=2):
         return None
 
 
+def bench_native_masked(inputs, repeats=3):
+    """The framework's production CPU path (allocate_tpu routes here when
+    no accelerator exists): greedy.cpp's feasibility-aware loop on the
+    same factorized snapshot. Returns (seconds, placed) or None."""
+    try:
+        from kube_batch_tpu.native import NativeUnavailable, solve_native
+    except Exception:
+        return None
+    try:
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            _, placed = solve_native(inputs)
+            times.append(time.perf_counter() - t0)
+        return min(times), placed
+    except NativeUnavailable:
+        return None
+
+
 def bench_tpu(cfg, seed=0, repeats=3):
     """Batched solve on a config: returns (host_snapshot_s, solve_s, placed)."""
     n_tasks, n_nodes, n_queues, n_groups = CONFIGS[cfg]
@@ -288,14 +307,32 @@ def main():
 
     import jax
 
+    headline_ms = solve_ms
+    headline_placed = tpu["placed"]
+    headline_solve_s = tpu["solve_s"]
+    if jax.devices()[0].platform == "cpu":
+        # No accelerator: the framework's production path is the native
+        # masked loop (allocate_tpu routes there), so THAT is the honest
+        # headline; the batched-kernel CPU time is kept as a side metric.
+        masked = bench_native_masked(tpu["inputs"])
+        if masked is not None:
+            masked_s, masked_placed = masked
+            headline_ms = masked_s * 1e3
+            headline_placed = masked_placed
+            headline_solve_s = masked_s
+            extra["jax_solve_cpu_ms"] = round(solve_ms, 1)
+            extra["solver_path"] = "native-masked-cpu-fallback"
+            if native is not None:
+                speedup = native[0] / masked_s
+
     print(json.dumps({
         "metric": f"gang-cycle-solve-latency-{headline_cfg}"
                   f"-{CONFIGS[headline_cfg][0]}x{CONFIGS[headline_cfg][1]}",
-        "value": round(solve_ms, 3),
+        "value": round(headline_ms, 3),
         "unit": "ms",
         "vs_baseline": round(speedup, 1),
-        "pods_placed": tpu["placed"],
-        "pods_placed_per_sec": round(tpu["placed"] / tpu["solve_s"], 1),
+        "pods_placed": headline_placed,
+        "pods_placed_per_sec": round(headline_placed / headline_solve_s, 1),
         "solver_rounds": tpu["rounds"],
         "host_snapshot_ms": round(tpu["snapshot_s"] * 1e3, 1),
         "session_open_ms": round(tpu["session_s"] * 1e3, 1),

@@ -132,6 +132,7 @@ class Session:
         self.node_order_fns = {}
         self.batch_node_order_fns = {}
         self.queue_budget_fns = {}
+        self.solver_score_weights = {}
 
     def _job_status(self, job: JobInfo):
         """Recompute PodGroup status (reference session.go:146-184)."""

@@ -7,6 +7,16 @@ on demand with the system toolchain; callers must handle
 :class:`NativeUnavailable` when no compiler is present.
 """
 
-from .greedy import NativeUnavailable, greedy_allocate, native_available
+from .greedy import (
+    NativeUnavailable,
+    greedy_allocate,
+    native_available,
+    solve_native,
+)
 
-__all__ = ["NativeUnavailable", "greedy_allocate", "native_available"]
+__all__ = [
+    "NativeUnavailable",
+    "greedy_allocate",
+    "native_available",
+    "solve_native",
+]

@@ -37,9 +37,16 @@ def _load() -> ctypes.CDLL:
         if _load_error is not None:
             raise NativeUnavailable(_load_error)
         try:
-            if not os.path.exists(_SO_PATH):
+            src = os.path.join(_NATIVE_DIR, "greedy.cpp")
+            # A prebuilt .so without sources (stripped deploy) must load
+            # as-is; rebuild only when the source is present and newer.
+            stale = not os.path.exists(_SO_PATH) or (
+                os.path.exists(src)
+                and os.path.getmtime(_SO_PATH) < os.path.getmtime(src)
+            )
+            if stale:
                 subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
+                    ["make", "-B", "-C", _NATIVE_DIR],
                     check=True,
                     capture_output=True,
                     text=True,
@@ -51,11 +58,25 @@ def _load() -> ctypes.CDLL:
             raise NativeUnavailable(_load_error) from e
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         lib.greedy_allocate.restype = ctypes.c_int64
         lib.greedy_allocate.argtypes = [
             f32p, i32p, f32p, f32p, f32p, f32p, f32p,
             ctypes.c_double, ctypes.c_double,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i32p,
+        ]
+        lib.greedy_allocate_masked.restype = ctypes.c_int64
+        lib.greedy_allocate_masked.argtypes = [
+            f32p, f32p, i32p, i32p, u8p, i32p,      # task req/fit/queue/job/valid/group
+            u8p, u8p,                               # node_feas, group_feas
+            i32p, u8p,                              # pair_idx, pair_feas
+            i32p, f32p,                             # score_idx, score_rows
+            f32p, f32p, i32p, i32p,                 # node idle/cap/task_count/max_tasks
+            f32p, f32p, f32p,                       # queue deserved/alloc, eps
+            ctypes.c_double, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             i32p,
         ]
         _lib = lib
@@ -99,5 +120,54 @@ def greedy_allocate(
         queue_deserved, queue_allocated, eps,
         float(lr_weight), float(br_weight),
         T, N, Q, R, out,
+    )
+    return out, int(placed)
+
+
+def solve_native(inputs) -> Tuple[np.ndarray, int]:
+    """Production CPU fallback: run greedy.cpp's feasibility-aware loop
+    (greedy_allocate_masked) on a solver :class:`PackedInputs` bundle.
+
+    Consumes the SAME factorized snapshot the TPU kernel consumes —
+    predicate groups/pairs, init-resreq fit vs resreq subtract, static
+    score rows, queue budgets, pod-count caps, and the reference's
+    job-break semantics (allocate.go:144-148). Returns
+    ``(assignment i32[T], placed)`` with node indices into the unfiltered
+    (padded) node table, matching ``SolveResult.assigned``'s contract so
+    ``allocate_tpu`` can apply either interchangeably."""
+    lib = _load()
+    s = inputs.unpack()
+
+    def f32(a):
+        return np.ascontiguousarray(np.asarray(a), np.float32)
+
+    def i32(a):
+        return np.ascontiguousarray(np.asarray(a), np.int32)
+
+    def u8(a):
+        return np.ascontiguousarray(np.asarray(a), np.uint8)
+
+    task_req, task_fit = f32(s.task_req), f32(s.task_fit)
+    T, R = task_req.shape
+    node_idle, node_cap = f32(s.node_idle), f32(s.node_cap)
+    N = node_idle.shape[0]
+    queue_deserved = f32(s.queue_deserved)
+    Q = queue_deserved.shape[0]
+    group_feas = u8(s.group_feas)
+    pair_idx, pair_feas = i32(s.pair_idx), u8(s.pair_feas)
+    score_idx, score_rows = i32(s.score_idx), f32(s.score_rows)
+    out = np.empty(T, dtype=np.int32)
+    placed = lib.greedy_allocate_masked(
+        task_req, task_fit, i32(s.task_queue), i32(s.task_job),
+        u8(s.task_valid), i32(s.task_group),
+        u8(s.node_feas), group_feas,
+        pair_idx, pair_feas,
+        score_idx, score_rows,
+        node_idle, node_cap, i32(s.node_task_count), i32(s.node_max_tasks),
+        queue_deserved, f32(s.queue_allocated), f32(s.eps),
+        float(np.asarray(s.lr_weight)), float(np.asarray(s.br_weight)),
+        T, N, Q, R,
+        group_feas.shape[0], pair_idx.shape[0], score_idx.shape[0],
+        out,
     )
     return out, int(placed)

@@ -24,6 +24,11 @@
 
 #include <cstdint>
 #include <cstring>
+#include <limits>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <utility>
 #include <vector>
 #include <cmath>
 
@@ -75,6 +80,40 @@ inline double score(const float* req, const float* idle, const float* cap,
   }
   return lr_w * lr + br_w * br;
 }
+
+// Lazy per-signature score heap (the masked loop's fast path).
+//
+// Tasks sharing (req, fit, predicate group) see the same score surface, and
+// a node's score only changes when an allocation lands on it. One max-heap
+// per signature class — entries (score, node), smallest node wins ties to
+// match the scan's first-best — turns the O(T·N) rescan into
+// O((T + N·S + allocations·S)·log). Stale entries are discarded on pop by
+// comparing against cur[]; removals are sound because idle only decreases
+// within a solve (a node that stopped fitting a signature never fits it
+// again) and pod-count caps only fill up.
+//
+// cur[n] sentinel states: finite = live score; -inf = fit-removed (still
+// counts as predicate-feasible for the job-break verdict, matching the
+// scan's any_feasible which is set BEFORE the fit check); NaN = cap-removed
+// or statically infeasible (not feasible for job-break purposes).
+struct SigEntryLess {
+  bool operator()(const std::pair<double, int32_t>& a,
+                  const std::pair<double, int32_t>& b) const {
+    if (a.first != b.first) return a.first < b.first;
+    return a.second > b.second;  // equal scores: lowest node index on top
+  }
+};
+
+struct SigHeap {
+  std::priority_queue<std::pair<double, int32_t>,
+                      std::vector<std::pair<double, int32_t>>, SigEntryLess>
+      heap;
+  std::vector<double> cur;     // per-node sentinel/score (see above)
+  const float* rep_req = nullptr;  // representative rows (identical across
+  const float* rep_fit = nullptr;  // every task of the signature)
+  int64_t feas_uncapped = 0;   // statically feasible & not cap-removed
+  bool init = false;
+};
 
 }  // namespace
 
@@ -203,6 +242,75 @@ int64_t greedy_allocate_masked(
   int64_t placed = 0;
   int64_t pcur = 0, scur = 0;
 
+  constexpr double kNegInf = -std::numeric_limits<double>::infinity();
+  constexpr int64_t kMinHeapTasks = 4;   // singletons scan; classes heap
+  constexpr size_t kMaxHeaps = 256;      // bound heap memory at N doubles each
+
+  // Pass 1: signature classes (req bytes + fit bytes + group id) for tasks
+  // with no private pair/score row. Exact byte keys — tasks of one class
+  // share rows, so a representative pointer suffices later.
+  std::unordered_map<std::string, int32_t> sig_ids;
+  std::vector<int32_t> task_sig(T, -1);
+  std::vector<int64_t> sig_count;
+  {
+    int64_t pc = 0, sc = 0;
+    std::string key;
+    for (int64_t t = 0; t < T; ++t) {
+      while (pc < P && pair_idx[pc] < t) ++pc;
+      while (sc < S && score_idx[sc] < t) ++sc;
+      if (!task_valid[t]) continue;
+      if (pc < P && pair_idx[pc] == t) continue;   // private predicate row
+      if (sc < S && score_idx[sc] == t) continue;  // private score row
+      key.assign(reinterpret_cast<const char*>(task_req + t * R),
+                 R * sizeof(float));
+      key.append(reinterpret_cast<const char*>(task_fit + t * R),
+                 R * sizeof(float));
+      const int32_t g = task_group[t];
+      key.append(reinterpret_cast<const char*>(&g), sizeof(g));
+      auto it = sig_ids.find(key);
+      if (it == sig_ids.end()) {
+        it = sig_ids.emplace(key, static_cast<int32_t>(sig_ids.size())).first;
+        sig_count.push_back(0);
+      }
+      task_sig[t] = it->second;
+      ++sig_count[it->second];
+    }
+  }
+  std::vector<SigHeap> heaps(sig_ids.size());
+  std::vector<int32_t> live_heaps;  // initialized heap sig ids
+
+  // Every allocation (either path) refreshes the landed node's entry in
+  // each live heap; all other nodes' scores are untouched.
+  auto apply_allocate = [&](int64_t t, int64_t n) {
+    const float* req = task_req + t * R;
+    float* nidle = idle.data() + n * R;
+    for (int64_t d = 0; d < R; ++d) nidle[d] -= req[d];
+    ntask[n] += 1;
+    const int64_t q = task_queue[t];
+    if (q >= 0 && q < Q) {
+      float* qa = qalloc.data() + q * R;
+      for (int64_t d = 0; d < R; ++d) qa[d] += req[d];
+    }
+    out_assign[t] = static_cast<int32_t>(n);
+    ++placed;
+    const bool capped = node_max_tasks[n] > 0 && ntask[n] >= node_max_tasks[n];
+    for (const int32_t s : live_heaps) {
+      SigHeap& h = heaps[s];
+      const double c = h.cur[n];
+      if (std::isnan(c)) continue;  // already cap-removed / infeasible
+      if (capped) {
+        h.cur[n] = std::numeric_limits<double>::quiet_NaN();
+        --h.feas_uncapped;
+        continue;
+      }
+      if (c == kNegInf) continue;  // fit-removed stays removed (idle shrank)
+      const double ns =
+          score(h.rep_req, nidle, node_cap + n * R, lr_w, br_w);
+      h.cur[n] = ns;
+      h.heap.push({ns, static_cast<int32_t>(n)});
+    }
+  };
+
   for (int64_t t = 0; t < T; ++t) {
     out_assign[t] = -1;
     // Advance the sparse-row cursors regardless of skips below so they
@@ -229,6 +337,54 @@ int64_t greedy_allocate_masked(
             ? group_feas + task_group[t] * N
             : nullptr;
 
+    // ---- heap fast path ------------------------------------------------
+    const int32_t sig = task_sig[t];
+    if (sig >= 0 && sig_count[sig] >= kMinHeapTasks &&
+        (heaps[sig].init || live_heaps.size() < kMaxHeaps)) {
+      SigHeap& h = heaps[sig];
+      if (!h.init) {
+        h.init = true;
+        h.rep_req = req;
+        h.rep_fit = fit;
+        h.cur.assign(N, std::numeric_limits<double>::quiet_NaN());
+        for (int64_t n = 0; n < N; ++n) {
+          if (!node_feas[n]) continue;
+          if (grow && !grow[n]) continue;
+          if (node_max_tasks[n] > 0 && ntask[n] >= node_max_tasks[n])
+            continue;
+          ++h.feas_uncapped;
+          const double s0 =
+              score(req, idle.data() + n * R, node_cap + n * R, lr_w, br_w);
+          h.cur[n] = s0;
+          h.heap.push({s0, static_cast<int32_t>(n)});
+        }
+        live_heaps.push_back(sig);
+      }
+      int64_t hbest = -1;
+      while (!h.heap.empty()) {
+        const auto top = h.heap.top();
+        const int64_t n = top.second;
+        if (top.first != h.cur[n]) {  // stale (NaN/-inf compare false too)
+          h.heap.pop();
+          continue;
+        }
+        if (!fits(h.rep_fit, idle.data() + n * R, eps, R)) {
+          h.cur[n] = kNegInf;  // permanent: idle only decreases
+          h.heap.pop();
+          continue;
+        }
+        hbest = n;
+        break;
+      }
+      if (hbest < 0) {
+        if (h.feas_uncapped == 0 && j >= 0 && j < T) job_failed[j] = 1;
+        continue;
+      }
+      apply_allocate(t, hbest);
+      continue;
+    }
+
+    // ---- scan path (private rows, rare signatures) ---------------------
     int64_t best = -1;
     double best_score = -1.0e300;
     bool any_feasible = false;
@@ -292,15 +448,7 @@ int64_t greedy_allocate_masked(
       if (!any_feasible && j >= 0 && j < T) job_failed[j] = 1;
       continue;
     }
-    float* nidle = idle.data() + best * R;
-    for (int64_t d = 0; d < R; ++d) nidle[d] -= req[d];
-    ntask[best] += 1;
-    if (q >= 0 && q < Q) {
-      float* qa = qalloc.data() + q * R;
-      for (int64_t d = 0; d < R; ++d) qa[d] += req[d];
-    }
-    out_assign[t] = static_cast<int32_t>(best);
-    ++placed;
+    apply_allocate(t, best);
   }
   return placed;
 }

@@ -9,6 +9,8 @@ kube_batch_tpu.utils.backend.force_cpu_devices, shared with the entry
 points.
 """
 
+import os
+
 from kube_batch_tpu.utils.backend import force_cpu_devices
 
 if not force_cpu_devices(8):
@@ -16,3 +18,10 @@ if not force_cpu_devices(8):
         "tests need an 8-device virtual CPU mesh, but a jax backend with "
         "fewer devices was already initialized before conftest ran"
     )
+
+# Pin allocate_tpu to the JAX kernel: on a CPU host with a toolchain the
+# action would otherwise auto-route to native/greedy.cpp, and the
+# accelerator path — the product's main solve path — would lose all its
+# action/e2e coverage. Native-route tests override per-test via
+# monkeypatch.setenv.
+os.environ.setdefault("KBT_SOLVER", "jax")
