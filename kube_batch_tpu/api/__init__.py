@@ -20,6 +20,7 @@ from .objects import (
     ObjectMeta,
     Pod,
     PodCondition,
+    PodDisruptionBudget,
     PodGroup,
     PodGroupCondition,
     PodGroupPhase,

@@ -116,6 +116,10 @@ class JobInfo:
         self.total_request = Resource.empty()
         self.creation_timestamp: float = 0.0
         self.pod_group: Optional[PodGroup] = None
+        # Legacy gang source (reference job_info.go:153, deprecated but
+        # part of the surface): a PodDisruptionBudget standing in for a
+        # PodGroup.
+        self.pdb = None
         for task in tasks:
             self.add_task_info(task)
 
@@ -132,6 +136,18 @@ class JobInfo:
 
     def unset_pod_group(self) -> None:
         self.pod_group = None
+
+    # -- PDB (legacy gang source, reference job_info.go:194-207) ------------
+
+    def set_pdb(self, pdb) -> None:
+        self.name = pdb.name
+        self.namespace = pdb.namespace
+        self.min_available = pdb.min_available
+        self.creation_timestamp = pdb.metadata.creation_timestamp
+        self.pdb = pdb
+
+    def unset_pdb(self) -> None:
+        self.pdb = None
 
     # -- task bookkeeping ---------------------------------------------------
 
@@ -197,6 +213,7 @@ class JobInfo:
         info.node_selector = dict(self.node_selector)
         info.creation_timestamp = self.creation_timestamp
         info.pod_group = self.pod_group
+        info.pdb = self.pdb
         info.total_request = self.total_request.clone()
         info.allocated = self.allocated.clone()
         for uid, task in self.tasks.items():

@@ -113,6 +113,10 @@ class PodSpec:
     priority: Optional[int] = None
     priority_class_name: str = ""
     scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    # PersistentVolumeClaim names this pod mounts (the subset of k8s
+    # spec.volumes the scheduler cares about: what must be assumable on
+    # the chosen node before bind, reference cache.go:200-268).
+    volume_claims: List[str] = field(default_factory=list)
 
 
 class PodPhase:
@@ -280,3 +284,22 @@ class PriorityClass:
     @property
     def name(self) -> str:
         return self.metadata.name
+
+
+@dataclass
+class PodDisruptionBudget:
+    """Legacy gang source (reference event_handlers.go:662-773): a PDB
+    whose controller owner matches a set of pods defines their gang's
+    minAvailable without a PodGroup. metadata.owner_uid keys the job, the
+    same way owned plain pods are keyed (apis/utils/utils.go:26-38)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_available: int = 1
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace

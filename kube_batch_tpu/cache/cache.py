@@ -80,14 +80,49 @@ class DefaultStatusUpdater(StatusUpdater):
 
 
 class DefaultVolumeBinder(VolumeBinder):
-    """reference cache.go:200-268. tpu-batch has no real PV layer; volumes are
-    modeled as instantly assumable (the seam stays for parity/tests)."""
+    """Assume/bind volume lifecycle (reference cache.go:200-268).
+
+    ``allocate_volumes`` assumes the pod's unbound claims onto the chosen
+    node (conflicting assumptions fail the allocation, like
+    AssumePodVolumes); ``task.volume_ready`` records whether every claim
+    was already bound. ``bind_volumes`` then waits — up to ``bind_timeout``
+    seconds, the reference's 30s — for the PV-controller analog to bind
+    the assumed claims, raising TimeoutError on expiry so the dispatch
+    fails and the task re-enters the resync path.
+
+    Without a cluster (standalone decision-core use), volumes are
+    instantly assumable, preserving the previous no-op behavior."""
+
+    def __init__(self, cluster: Optional[ClusterAPI] = None,
+                 bind_timeout: float = 30.0):
+        self.cluster = cluster
+        self.bind_timeout = bind_timeout
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
-        task.volume_ready = True
+        if self.cluster is None or not task.pod.spec.volume_claims:
+            task.volume_ready = True
+            return
+        task.volume_ready = self.cluster.assume_pod_volumes(
+            task.pod, hostname
+        )
 
     def bind_volumes(self, task: TaskInfo) -> None:
-        return None
+        if task.volume_ready or self.cluster is None:
+            return  # cache.go:214-217: ready volumes are not re-bound
+        if not self.cluster.wait_pod_volumes_bound(
+            task.pod, self.bind_timeout
+        ):
+            raise TimeoutError(
+                f"volumes of {task.namespace}/{task.name} not bound "
+                f"within {self.bind_timeout}s"
+            )
+        task.volume_ready = True
+
+    def release_volumes(self, task: TaskInfo) -> None:
+        """Drop the task's claim assumptions after a failed bind so the
+        next cycle can place it (or a competitor) elsewhere."""
+        if self.cluster is not None:
+            self.cluster.release_pod_volumes(task.pod)
 
 
 class SchedulerCache(Cache, EventHandlersMixin):
@@ -120,7 +155,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self.status_updater = status_updater or (
             DefaultStatusUpdater(cluster) if cluster else None
         )
-        self.volume_binder = volume_binder or DefaultVolumeBinder()
+        self.volume_binder = volume_binder or DefaultVolumeBinder(cluster)
 
         # Rate-limited retry queues (reference cache.go:588-608, :556-585).
         # Items carry a retry count; re-queues back off exponentially.
@@ -186,6 +221,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
             ("PriorityClass", ADDED): self.add_priority_class,
             ("PriorityClass", MODIFIED): lambda o: self.update_priority_class(o, o),
             ("PriorityClass", DELETED): self.delete_priority_class,
+            ("PodDisruptionBudget", ADDED): self.add_pdb,
+            ("PodDisruptionBudget", MODIFIED): lambda o: self.update_pdb(o, o),
+            ("PodDisruptionBudget", DELETED): self.delete_pdb,
         }
 
     def _on_watch_event(self, kind: str, event_type: str, obj) -> None:
@@ -205,7 +243,14 @@ class SchedulerCache(Cache, EventHandlersMixin):
             # Watch BEFORE the initial list so objects created during the list
             # are not lost; duplicate ADDs are tolerated (handlers key by uid).
             self.cluster.add_watch(self._on_watch_event)
-            for kind in ("Node", "Queue", "PriorityClass", "PodGroup", "Pod"):
+            for kind in (
+                "Node",
+                "Queue",
+                "PriorityClass",
+                "PodGroup",
+                "PodDisruptionBudget",
+                "Pod",
+            ):
                 for obj in self.cluster.list_objects(kind):
                     self._on_watch_event(kind, ADDED, obj)
             self._synced = True
@@ -274,8 +319,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
             for name, q in self.queues.items():
                 snap.queues[name] = q.clone()
             for key, job in self.jobs.items():
-                # Jobs without a scheduling spec are not schedulable.
-                if job.pod_group is None:
+                # Jobs without a scheduling spec (neither PodGroup nor the
+                # legacy PDB source) are not schedulable
+                # (reference cache.go:634-640).
+                if job.pod_group is None and job.pdb is None:
                     continue
                 if self.enable_priority_class and job.pod_group is not None:
                     job.priority = self.default_priority
@@ -322,6 +369,12 @@ class SchedulerCache(Cache, EventHandlersMixin):
 
         def _do_bind():
             try:
+                # The volume bind wait (up to the reference's 30s,
+                # cache.go:260-268) runs HERE on the side-effect pool, not
+                # in the scheduling loop — one slow volume must not stall
+                # every other job's cycle. A timeout releases the claim
+                # assumptions and resyncs the task without binding the pod.
+                self.volume_binder.bind_volumes(task_snapshot)
                 self.binder.bind(pod, hostname)
                 if self.cluster is not None:
                     self.cluster.record_event(
@@ -329,6 +382,14 @@ class SchedulerCache(Cache, EventHandlersMixin):
                         f"Successfully assigned {pod.namespace}/{pod.name} to {hostname}",
                     )
             except Exception:
+                release = getattr(self.volume_binder, "release_volumes", None)
+                if release is not None:
+                    try:
+                        release(task_snapshot)
+                    except Exception:
+                        logger.exception(
+                            "failed to release volumes of %s", task.uid
+                        )
                 self._resync_task(task_snapshot)
 
         if self.binder is not None:
@@ -368,7 +429,13 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self.volume_binder.allocate_volumes(task, hostname)
 
     def bind_volumes(self, task: TaskInfo) -> None:
-        self.volume_binder.bind_volumes(task)
+        """Dispatch-time seam (session.go:294-316 calls BindVolumes before
+        Bind). Ready volumes short-circuit here; UNready volumes are bound
+        inside the async bind job (cache.bind._do_bind) so a slow volume
+        wait never blocks the scheduling loop — a failed/timed-out bind
+        there releases the claim assumptions and resyncs the task."""
+        if task.volume_ready:
+            self.volume_binder.bind_volumes(task)
 
     # -- status / events -----------------------------------------------------
 

@@ -10,6 +10,7 @@ All handlers take the cache mutex; they mutate Jobs/Nodes/Queues maps only.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 from ..api import (
@@ -25,6 +26,8 @@ from ..api import (
     TaskStatus,
 )
 from .util import create_shadow_pod_group, job_terminated, shadow_pod_group
+
+logger = logging.getLogger(__name__)
 
 
 def _is_terminated(status: TaskStatus) -> bool:
@@ -236,6 +239,52 @@ class EventHandlersMixin:
                 job.unset_pod_group()
                 if job_terminated(job):
                     self._queue_job_cleanup(job)
+
+    # ---- PodDisruptionBudgets (reference event_handlers.go:662-773) --------
+    # Legacy gang source: a PDB owned by a controller defines minAvailable
+    # for the pods of that controller, without any PodGroup. The job key is
+    # the PDB's controller owner UID — the same key owned plain pods file
+    # under via the shadow-PodGroup path, so the two meet in one JobInfo.
+
+    def _set_pdb_locked(self, pdb) -> bool:
+        job_key = pdb.metadata.owner_uid or ""
+        if not job_key:
+            # An ownerless PDB is an ordinary disruption budget, not a
+            # gang source — common in real clusters, so skip quietly
+            # rather than raising per watch event.
+            logger.debug(
+                "PodDisruptionBudget %s/%s has no controller owner; "
+                "not a gang source", pdb.namespace, pdb.name,
+            )
+            return False
+        job = self.jobs.get(job_key)
+        if job is None:
+            job = self.jobs[job_key] = JobInfo(job_key)
+        job.set_pdb(pdb)
+        # PDBs carry no queue; they land on the default queue
+        # (event_handlers.go:676).
+        job.queue = self.default_queue
+        return True
+
+    def add_pdb(self, pdb) -> None:
+        with self.mutex:
+            self._set_pdb_locked(pdb)
+
+    def update_pdb(self, old_pdb, new_pdb) -> None:
+        with self.mutex:
+            self._set_pdb_locked(new_pdb)
+
+    def delete_pdb(self, pdb) -> None:
+        with self.mutex:
+            job_key = pdb.metadata.owner_uid or ""
+            job = self.jobs.get(job_key)
+            if job is None:
+                return
+            job.unset_pdb()
+            # The cleanup loop re-checks job_terminated before removal, so
+            # queueing unconditionally matches the reference's deleteJob
+            # (event_handlers.go:696-700, cache.go:556-585).
+            self._queue_job_cleanup(job)
 
     # ---- queues (reference event_handlers.go:775-1036) ---------------------
 

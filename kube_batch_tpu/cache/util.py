@@ -42,6 +42,11 @@ def create_shadow_pod_group(pod: Pod) -> PodGroup:
 
 
 def job_terminated(job) -> bool:
-    """A job is terminated when its pod group is gone (or shadow) and no tasks
-    remain (reference cache.go job cleanup path, cache.go:556-585)."""
-    return shadow_pod_group(job.pod_group) and len(job.tasks) == 0
+    """A job is terminated when its scheduling spec is gone — pod group
+    absent (or shadow) and no legacy PDB attached — and no tasks remain
+    (reference api/helpers.go:101-106, cache.go:556-585)."""
+    return (
+        shadow_pod_group(job.pod_group)
+        and getattr(job, "pdb", None) is None
+        and len(job.tasks) == 0
+    )

@@ -14,7 +14,7 @@ Multi-document YAML is supported; unknown kinds raise.
 
 from __future__ import annotations
 
-from typing import Iterable, List, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 import yaml
 
@@ -26,6 +26,7 @@ from ..api.objects import (
     NodeStatus,
     ObjectMeta,
     Pod,
+    PodDisruptionBudget,
     PodGroup,
     PodGroupSpec,
     PodSpec,
@@ -42,12 +43,18 @@ SUPPORTED_VERSIONS = ("v1alpha1", "v1alpha2")
 
 def _meta(doc: dict) -> ObjectMeta:
     m = doc.get("metadata", {}) or {}
+    owner_uid = None
+    for ref in m.get("ownerReferences", []) or []:
+        if ref.get("controller"):
+            owner_uid = ref.get("uid") or ref.get("name")
+            break
     return ObjectMeta(
         name=m.get("name", ""),
         namespace=m.get("namespace", ""),
         uid=m.get("uid", "") or f"{m.get('namespace', '')}-{m.get('name', '')}",
         labels=dict(m.get("labels", {}) or {}),
         annotations=dict(m.get("annotations", {}) or {}),
+        owner_uid=owner_uid,
     )
 
 
@@ -204,6 +211,11 @@ def _pod(doc: dict) -> Pod:
         ))
         ports.extend(cports)
     affinity = spec.get("affinity")
+    claims = [
+        v["persistentVolumeClaim"]["claimName"]
+        for v in spec.get("volumes", []) or []
+        if v.get("persistentVolumeClaim", {}).get("claimName")
+    ]
     pod = Pod(
         metadata=_meta(doc),
         spec=PodSpec(
@@ -218,6 +230,7 @@ def _pod(doc: dict) -> Pod:
             scheduler_name=spec.get(
                 "schedulerName", PodSpec().scheduler_name
             ),
+            volume_claims=claims,
         ),
     )
     pod.status.phase = status.get("phase", PodPhase.PENDING)
@@ -245,6 +258,24 @@ def _node(doc: dict) -> Node:
         for t in spec.get("taints", []) or []
     ]
     return node
+
+
+def _pdb(doc: dict) -> Optional[PodDisruptionBudget]:
+    """A PDB acts as a legacy gang source ONLY when it has a controller
+    owner and an absolute minAvailable (reference event_handlers.go:662-700
+    keys the job by the controller UID). Ordinary disruption budgets —
+    label-selector based, ownerless, or percentage minAvailable — are not
+    gang specs; they load as a no-op instead of failing the manifest."""
+    meta = _meta(doc)
+    spec = doc.get("spec", {}) or {}
+    min_available = spec.get("minAvailable", 1)
+    if not meta.owner_uid:
+        return None
+    if isinstance(min_available, str):
+        if min_available.endswith("%"):
+            return None
+        min_available = int(min_available)
+    return PodDisruptionBudget(metadata=meta, min_available=int(min_available))
 
 
 def _priority_class(doc: dict) -> PriorityClass:
@@ -281,19 +312,40 @@ def parse_manifest(doc: dict) -> Tuple[str, object]:
             return "Node", _node(doc)
         if kind == "PriorityClass":
             return "PriorityClass", _priority_class(doc)
+        if kind == "PersistentVolumeClaim":
+            meta = doc.get("metadata", {}) or {}
+            phase = (doc.get("status", {}) or {}).get("phase", "")
+            return "PersistentVolumeClaim", {
+                "namespace": meta.get("namespace", ""),
+                "name": meta.get("name", ""),
+                "bound": phase == "Bound",
+            }
     if group == "scheduling.k8s.io" and kind == "PriorityClass":
         return "PriorityClass", _priority_class(doc)
+    if group == "policy" and kind == "PodDisruptionBudget":
+        pdb = _pdb(doc)
+        # (None, None) = recognized but not applicable (no controller
+        # owner / percentage budget): not a gang source, skip quietly.
+        return ("PodDisruptionBudget", pdb) if pdb else (None, None)
     raise ValueError(f"unsupported manifest {api_version!r} kind {kind!r}")
 
 
 def apply_manifests(cluster: InProcessCluster, docs: Iterable[dict]) -> int:
-    """Create every manifest object in the cluster; returns the count."""
+    """Create every manifest object in the cluster; returns the count of
+    applied objects (recognized-but-skipped documents are not counted)."""
     n = 0
     for doc in docs:
         if not doc:
             continue
         kind, obj = parse_manifest(doc)
-        cluster.create(kind, obj)
+        if kind is None:
+            continue
+        if kind == "PersistentVolumeClaim":
+            cluster.create_claim(
+                obj["namespace"], obj["name"], bound=obj["bound"]
+            )
+        else:
+            cluster.create(kind, obj)
         n += 1
     return n
 

@@ -77,7 +77,14 @@ class InProcessCluster(ClusterAPI):
     ``simulate_kubelet=True`` makes binds eventually set the pod Running
     (the hollow-node/kubemark analog, reference test/kubemark/)."""
 
-    KINDS = ("Pod", "Node", "PodGroup", "Queue", "PriorityClass")
+    KINDS = (
+        "Pod",
+        "Node",
+        "PodGroup",
+        "Queue",
+        "PriorityClass",
+        "PodDisruptionBudget",
+    )
 
     def __init__(
         self,
@@ -97,6 +104,12 @@ class InProcessCluster(ClusterAPI):
         self._kubelet_queue: "deque" = deque()
         self._kubelet_thread: Optional[threading.Thread] = None
         self.events: List[tuple] = []  # recorded cluster events (observability)
+        # PersistentVolumeClaim analog (reference wraps the k8s
+        # volumebinder, cache.go:200-268): ns/name -> {"bound": bool,
+        # "assumed_node": str|None}. A Condition signals binds so waiters
+        # need no polling.
+        self._claims: Dict[str, Dict] = {}
+        self._claims_changed = threading.Condition(self._lock)
 
     # -- internal -----------------------------------------------------------
 
@@ -220,7 +233,81 @@ class InProcessCluster(ClusterAPI):
 
     def delete_pod(self, pod: Pod) -> None:
         """Analog of pod DELETE for eviction (reference cache.go:137-148)."""
+        self.release_pod_volumes(pod)
         self.delete("Pod", pod)
+
+    # -- volume claims (PV-controller analog, reference cache.go:200-268) ---
+
+    def create_claim(self, namespace: str, name: str, bound: bool = False) -> None:
+        with self._lock:
+            self._claims[f"{namespace}/{name}"] = {
+                "bound": bound, "assumed_node": None, "assumed_pod": None,
+            }
+
+    def set_claim_bound(self, namespace: str, name: str) -> None:
+        """What the PV controller would do once a volume is provisioned."""
+        with self._claims_changed:
+            claim = self._claims.get(f"{namespace}/{name}")
+            if claim is None:
+                raise KeyError(f"claim {namespace}/{name} not found")
+            claim["bound"] = True
+            self._claims_changed.notify_all()
+
+    def assume_pod_volumes(self, pod: Pod, hostname: str) -> bool:
+        """Assume the pod's unbound claims onto ``hostname``; returns True
+        iff every claim was ALREADY bound (the k8s AssumePodVolumes
+        contract the reference relies on, cache.go:205-210). The same pod
+        may re-assume a claim onto a different node (a later cycle chose
+        elsewhere); only assumptions held by a DIFFERENT pod conflict."""
+        with self._lock:
+            all_bound = True
+            for name in pod.spec.volume_claims:
+                key = f"{pod.namespace}/{name}"
+                claim = self._claims.get(key)
+                if claim is None:
+                    raise KeyError(f"claim {key} not found")
+                if claim["bound"]:
+                    continue
+                all_bound = False
+                holder = claim["assumed_pod"]
+                if holder is not None and holder != pod.uid:
+                    raise ValueError(
+                        f"claim {key} already assumed by another pod on "
+                        f"{claim['assumed_node']}"
+                    )
+                claim["assumed_node"] = hostname
+                claim["assumed_pod"] = pod.uid
+            return all_bound
+
+    def release_pod_volumes(self, pod: Pod) -> None:
+        """Drop this pod's claim assumptions (after a failed/timed-out
+        bind, or when the pod is deleted) so another placement — or
+        another pod — can assume them."""
+        with self._lock:
+            for name in pod.spec.volume_claims:
+                claim = self._claims.get(f"{pod.namespace}/{name}")
+                if claim is not None and claim["assumed_pod"] == pod.uid:
+                    claim["assumed_node"] = None
+                    claim["assumed_pod"] = None
+
+    def wait_pod_volumes_bound(self, pod: Pod, timeout: float) -> bool:
+        """Block until every claim of ``pod`` is bound, or ``timeout``
+        elapses (the 30s bind wait of reference cache.go:260-268)."""
+        deadline = time.monotonic() + timeout
+        with self._claims_changed:
+            while True:
+                pending = [
+                    name for name in pod.spec.volume_claims
+                    if not self._claims.get(
+                        f"{pod.namespace}/{name}", {"bound": False}
+                    )["bound"]
+                ]
+                if not pending:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._claims_changed.wait(remaining)
 
     def update_pod_condition(self, pod: Pod, condition: PodCondition) -> None:
         with self._lock:

@@ -239,6 +239,15 @@ class Session:
             raise KeyError(
                 f"failed to find job <{job_info.namespace}/{job_info.name}>"
             )
+        if job.pod_group is None:
+            # Legacy PDB-sourced jobs have no PodGroup to carry conditions
+            # (the reference would nil-deref here, session.go:368 — we log
+            # instead; the diagnosis still reaches the user via events).
+            logger.debug(
+                "job <%s/%s> has no PodGroup; dropping condition %s",
+                job.namespace, job.name, cond.type,
+            )
+            return
         for i, c in enumerate(job.pod_group.status.conditions):
             if c.type == cond.type:
                 job.pod_group.status.conditions[i] = cond
