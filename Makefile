@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf bench verify clean
+.PHONY: all native test e2e perf bench verify ci image clean
 
 all: native
 
@@ -32,6 +32,18 @@ bench:
 # Static checks: compileall as the gofmt/golint analog.
 verify:
 	$(PY) -m compileall -q kube_batch_tpu tests bench.py __graft_entry__.py
+
+# The exact CI pipeline (.github/workflows/ci.yml), runnable locally:
+# verify -> native -> test -> perf smoke -> bench smoke
+# (reference .travis.yml:21-25).
+ci: verify native test
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
+		--group-size 10 --out perf-artifact.json
+	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small
+
+# Scheduler container (reference deployment/images/Dockerfile analog).
+image:
+	docker build -f deployment/images/Dockerfile -t tpu-batch:latest .
 
 clean:
 	$(MAKE) -C native clean

@@ -115,9 +115,19 @@ struct SigHeap {
   bool init = false;
 };
 
+// Below ~1M (task, node) pairs the plain scan wins: heap init plus the
+// per-allocation refresh across signature classes costs more than it
+// saves (measured: 1k x 100 runs 4x faster scanned). Settable so tests
+// can force the heap path on small instances.
+int64_t g_heap_pair_threshold = int64_t{1} << 20;
+
 }  // namespace
 
 extern "C" {
+
+void greedy_set_heap_threshold(int64_t pairs) {
+  g_heap_pair_threshold = pairs;
+}
 
 // Runs the greedy allocate loop. Arrays are row-major float32/int32.
 // node_idle and queue_alloc are COPIED internally; out_assign[T] receives
@@ -245,6 +255,7 @@ int64_t greedy_allocate_masked(
   constexpr double kNegInf = -std::numeric_limits<double>::infinity();
   constexpr int64_t kMinHeapTasks = 4;   // singletons scan; classes heap
   constexpr size_t kMaxHeaps = 256;      // bound heap memory at N doubles each
+  const bool use_heaps = T * N >= g_heap_pair_threshold;
 
   // Pass 1: signature classes (req bytes + fit bytes + group id) for tasks
   // with no private pair/score row. Exact byte keys — tasks of one class
@@ -252,7 +263,7 @@ int64_t greedy_allocate_masked(
   std::unordered_map<std::string, int32_t> sig_ids;
   std::vector<int32_t> task_sig(T, -1);
   std::vector<int64_t> sig_count;
-  {
+  if (use_heaps) {
     int64_t pc = 0, sc = 0;
     std::string key;
     for (int64_t t = 0; t < T; ++t) {
@@ -339,7 +350,7 @@ int64_t greedy_allocate_masked(
 
     // ---- heap fast path ------------------------------------------------
     const int32_t sig = task_sig[t];
-    if (sig >= 0 && sig_count[sig] >= kMinHeapTasks &&
+    if (use_heaps && sig >= 0 && sig_count[sig] >= kMinHeapTasks &&
         (heaps[sig].init || live_heaps.size() < kMaxHeaps)) {
       SigHeap& h = heaps[sig];
       if (!h.init) {

@@ -291,9 +291,19 @@ def test_masked_heap_path_matches_scan_semantics(seed):
     """Randomized exact-parity: signature classes big enough to take the
     heap fast path must produce byte-identical assignments to the
     sequential scan transcription (same argmax, same job-break)."""
+    import ctypes
+
     from kube_batch_tpu.native.greedy import _load
     lib = _load()
+    lib.greedy_set_heap_threshold.argtypes = [ctypes.c_int64]
+    lib.greedy_set_heap_threshold(0)  # force the heap path on small shapes
+    try:
+        _run_masked_parity(lib, seed)
+    finally:
+        lib.greedy_set_heap_threshold(1 << 20)
 
+
+def _run_masked_parity(lib, seed):
     rng = np.random.RandomState(seed)
     T, N, Q, R, G = 160, 12, 3, 2, 2
     # few distinct requests -> large signature classes (heap path active)
