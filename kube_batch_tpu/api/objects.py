@@ -25,6 +25,10 @@ from .resource_info import ResourceList
 # reference: apis/scheduling/v1alpha1/labels.go:21
 GROUP_NAME_ANNOTATION_KEY = "scheduling.k8s.io/group-name"
 
+# CRD API group of PodGroup/Queue (reference register.go; one source of
+# truth for both the manifest loader and the real-cluster adapter paths).
+SCHEDULING_GROUP = "scheduling.incubator.k8s.io"
+
 # Default scheduler name (reference: cmd/kube-batch/app/options/options.go:62).
 DEFAULT_SCHEDULER_NAME = "tpu-batch"
 

@@ -37,7 +37,8 @@ from ..api.objects import (
 )
 from ..cluster import InProcessCluster
 
-SCHEDULING_GROUP = "scheduling.incubator.k8s.io"
+from ..api.objects import SCHEDULING_GROUP  # noqa: E402 (re-export)
+
 SUPPORTED_VERSIONS = ("v1alpha1", "v1alpha2")
 
 

@@ -27,7 +27,9 @@ RETRY_PERIOD = 5.0
 class ServerOption:
     """reference options.go:33-56"""
 
-    cluster_state: str = ""          # standalone analog of --master/--kubeconfig
+    cluster_state: str = ""          # standalone in-process cluster seed
+    master: str = ""                 # k8s API server URL (reference --master)
+    kubeconfig: str = ""             # kubeconfig path (reference --kubeconfig)
     scheduler_name: str = DEFAULT_SCHEDULER_NAME
     scheduler_conf: str = ""
     schedule_period: float = DEFAULT_SCHEDULER_PERIOD
@@ -66,7 +68,15 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--cluster-state", default="",
         help="YAML file describing nodes/queues/podgroups/pods to load into "
-             "the in-process cluster (standalone analog of --master)")
+             "the in-process cluster (standalone mode)")
+    parser.add_argument(
+        "--master", default="",
+        help="The address of the Kubernetes API server (overrides any "
+             "value in kubeconfig)")
+    parser.add_argument(
+        "--kubeconfig", default="",
+        help="Path to kubeconfig file with authorization and master "
+             "location information; enables real-cluster mode")
     parser.add_argument(
         "--scheduler-name", default=DEFAULT_SCHEDULER_NAME,
         help="tpu-batch will handle pods whose .spec.SchedulerName is same as "
@@ -116,6 +126,8 @@ def parse_options(argv: Optional[List[str]] = None) -> ServerOption:
     ns = parser.parse_args(argv)
     return ServerOption(
         cluster_state=ns.cluster_state,
+        master=ns.master,
+        kubeconfig=ns.kubeconfig,
         scheduler_name=ns.scheduler_name,
         scheduler_conf=ns.scheduler_conf,
         schedule_period=ns.schedule_period,

@@ -194,7 +194,16 @@ def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
     """reference app/server.go:63-141 Run."""
     register_options(opt)
     if cluster is None:
-        if opt.cluster_state:
+        if opt.master or opt.kubeconfig:
+            # Real-cluster mode (reference server.go:56-61 buildConfig).
+            from ..cluster.kube import KubeCluster, KubeConfig
+
+            cluster = KubeCluster(
+                KubeConfig.resolve(
+                    kubeconfig=opt.kubeconfig, master=opt.master
+                )
+            )
+        elif opt.cluster_state:
             cluster = load_cluster_state(
                 opt.cluster_state, simulate_kubelet=opt.simulate_kubelet
             )

@@ -1,1 +1,16 @@
 from .api import ADDED, DELETED, MODIFIED, ClusterAPI, InProcessCluster
+
+__all__ = [
+    "ADDED", "DELETED", "MODIFIED", "ClusterAPI", "InProcessCluster",
+    "KubeCluster", "KubeConfig",
+]
+
+
+def __getattr__(name):
+    # Lazy: the real-cluster adapter pulls in yaml/ssl; embedders of the
+    # decision core alone must not pay that import (PEP 562).
+    if name in ("KubeCluster", "KubeConfig"):
+        from . import kube
+
+        return getattr(kube, name)
+    raise AttributeError(name)

@@ -1,0 +1,320 @@
+"""Real-cluster adapter (cluster/kube.py) against a fake Kubernetes API
+server: stdlib HTTP server speaking just enough of the k8s REST protocol
+— JSON lists, streaming ?watch=true, the Binding subresource, status
+PATCHes — to drive the whole scheduler end-to-end, the kind-cluster e2e
+analog (reference hack/run-e2e-kind.sh) without a cluster."""
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import kube_batch_tpu.actions  # noqa: F401
+import kube_batch_tpu.plugins  # noqa: F401
+from kube_batch_tpu.api import PodPhase
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.cluster import KubeCluster, KubeConfig
+from kube_batch_tpu.scheduler import Scheduler
+
+GROUP = "scheduling.incubator.k8s.io"
+
+
+def pod_doc(name, ns="default", cpu="500m", group=None, phase="Pending"):
+    meta = {"name": name, "namespace": ns, "uid": f"uid-{ns}-{name}"}
+    if group:
+        meta["annotations"] = {"scheduling.k8s.io/group-name": group}
+    return {
+        "apiVersion": "v1", "kind": "Pod", "metadata": meta,
+        "spec": {"containers": [
+            {"name": "main", "resources": {"requests": {
+                "cpu": cpu, "memory": "256Mi",
+            }}},
+        ]},
+        "status": {"phase": phase},
+    }
+
+
+def node_doc(name, cpu="4", pods="20"):
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name, "uid": f"uid-{name}"},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": "8Gi", "pods": pods},
+            "capacity": {"cpu": cpu, "memory": "8Gi", "pods": pods},
+        },
+    }
+
+
+class FakeKube:
+    """In-memory k8s API server: lists, watches, binding, status patches."""
+
+    PATHS = {
+        "/api/v1/pods": "Pod",
+        "/api/v1/nodes": "Node",
+        f"/apis/{GROUP}/v1alpha1/podgroups": "PodGroup",
+        f"/apis/{GROUP}/v1alpha1/queues": "Queue",
+        "/apis/scheduling.k8s.io/v1/priorityclasses": "PriorityClass",
+        "/apis/policy/v1/poddisruptionbudgets": "PodDisruptionBudget",
+    }
+
+    def __init__(self):
+        self.objects = {kind: {} for kind in self.PATHS.values()}
+        self.subscribers = {kind: [] for kind in self.PATHS.values()}
+        self.bindings = []
+        self.status_patches = []
+        self.lock = threading.RLock()
+        self.rv = 0
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"  # close-delimited watch streams
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, body):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _read_body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def do_GET(self):
+                path, _, qs = self.path.partition("?")
+                kind = fake.PATHS.get(path)
+                if kind is None:
+                    # Item GET: /api/v1/namespaces/{ns}/pods/{name}
+                    if "/namespaces/" in path:
+                        parts = path.split("/")
+                        ns, name = parts[4], parts[6]
+                        with fake.lock:
+                            pod = fake.objects["Pod"].get(f"{ns}/{name}")
+                        if pod is None:
+                            self._json(404, {"kind": "Status", "code": 404})
+                        else:
+                            self._json(200, pod)
+                        return
+                    self._json(404, {"kind": "Status", "code": 404})
+                    return
+                if "watch=true" in qs:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    q = queue.Queue()
+                    with fake.lock:
+                        fake.subscribers[kind].append(q)
+                    try:
+                        while True:
+                            try:
+                                event = q.get(timeout=0.2)
+                            except queue.Empty:
+                                continue
+                            if event is None:
+                                return
+                            self.wfile.write(
+                                (json.dumps(event) + "\n").encode()
+                            )
+                            self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        return
+                with fake.lock:
+                    items = list(fake.objects[kind].values())
+                    rv = str(fake.rv)
+                api_version = "v1" if path.startswith("/api/v1") else \
+                    path.split("/apis/", 1)[1].rsplit("/", 1)[0].replace(
+                        "/", "/", 1
+                    )
+                if not path.startswith("/api/v1"):
+                    parts = path.split("/")
+                    api_version = f"{parts[2]}/{parts[3]}"
+                self._json(200, {
+                    "apiVersion": api_version, "kind": f"{kind}List",
+                    "metadata": {"resourceVersion": rv},
+                    "items": items,
+                })
+
+            def do_POST(self):
+                if self.path.endswith("/binding"):
+                    body = self._read_body()
+                    parts = self.path.split("/")
+                    ns, name = parts[4], parts[6]
+                    hostname = body.get("target", {}).get("name", "")
+                    with fake.lock:
+                        pod = fake.objects["Pod"].get(f"{ns}/{name}")
+                        if pod is None:
+                            self._json(404, {"code": 404})
+                            return
+                        pod["spec"]["nodeName"] = hostname
+                        pod["status"]["phase"] = "Running"  # hollow kubelet
+                        fake.bindings.append((f"{ns}/{name}", hostname))
+                        fake._emit("Pod", "MODIFIED", pod)
+                    self._json(201, {"kind": "Status", "status": "Success"})
+                    return
+                if "/events" in self.path:
+                    self._json(201, {"kind": "Status", "status": "Success"})
+                    return
+                self._json(404, {"code": 404})
+
+            def do_PATCH(self):
+                body = self._read_body()
+                with fake.lock:
+                    fake.status_patches.append((self.path, body))
+                self._json(200, {"kind": "Status", "status": "Success"})
+
+            def do_DELETE(self):
+                parts = self.path.split("/")
+                ns, name = parts[4], parts[6]
+                with fake.lock:
+                    pod = fake.objects["Pod"].pop(f"{ns}/{name}", None)
+                    if pod is not None:
+                        fake._emit("Pod", "DELETED", pod)
+                self._json(200, {"kind": "Status", "status": "Success"})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def url(self):
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def _key(self, doc):
+        m = doc["metadata"]
+        ns = m.get("namespace", "")
+        return f"{ns}/{m['name']}" if ns else m["name"]
+
+    def _emit(self, kind, etype, doc):
+        self.rv += 1
+        doc.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        for q in self.subscribers[kind]:
+            q.put({"type": etype, "object": doc})
+
+    def create(self, kind, doc):
+        with self.lock:
+            self.objects[kind][self._key(doc)] = doc
+            self._emit(kind, "ADDED", doc)
+
+    def close(self):
+        with self.lock:
+            for qs in self.subscribers.values():
+                for q in qs:
+                    q.put(None)
+        self.server.shutdown()
+
+
+@pytest.fixture
+def fake():
+    f = FakeKube()
+    yield f
+    f.close()
+
+
+def make_cluster(fake):
+    return KubeCluster(
+        KubeConfig(fake.url), reconnect_delay=0.05,
+    )
+
+
+class TestKubeCluster:
+    def test_list_converts_domain_objects(self, fake):
+        fake.create("Node", node_doc("n1"))
+        fake.create("Pod", pod_doc("p1"))
+        fake.create("Queue", {
+            "apiVersion": f"{GROUP}/v1alpha1", "kind": "Queue",
+            "metadata": {"name": "q1"}, "spec": {"weight": 3},
+        })
+        cluster = make_cluster(fake)
+        nodes = cluster.list_objects("Node")
+        pods = cluster.list_objects("Pod")
+        queues = cluster.list_objects("Queue")
+        assert [n.metadata.name for n in nodes] == ["n1"]
+        assert [p.metadata.name for p in pods] == ["p1"]
+        assert queues[0].spec.weight == 3
+
+    def test_watch_delivers_events(self, fake):
+        cluster = make_cluster(fake)
+        got = []
+        ready = threading.Event()
+        cluster.add_watch(
+            lambda kind, etype, obj: (got.append((kind, etype)), ready.set())
+        )
+        time.sleep(0.3)  # let watch connections establish
+        fake.create("Pod", pod_doc("p1"))
+        assert ready.wait(5.0), got
+        assert ("Pod", "ADDED") in got
+        cluster.stop()
+
+    def test_bind_pod_posts_binding(self, fake):
+        fake.create("Pod", pod_doc("p1"))
+        cluster = make_cluster(fake)
+        pod = cluster.list_objects("Pod")[0]
+        cluster.bind_pod(pod, "n1")
+        assert fake.bindings == [("default/p1", "n1")]
+        assert cluster.get_pod("default", "p1").spec.node_name == "n1"
+
+    def test_update_pod_group_patches_status(self, fake):
+        fake.create("PodGroup", {
+            "apiVersion": f"{GROUP}/v1alpha1", "kind": "PodGroup",
+            "metadata": {"name": "g1", "namespace": "default"},
+            "spec": {"minMember": 1},
+        })
+        cluster = make_cluster(fake)
+        pg = cluster.list_objects("PodGroup")[0]
+        pg.status.phase = "Running"
+        pg.status.running = 1
+        cluster.update_pod_group(pg)
+        path, body = fake.status_patches[-1]
+        assert path.endswith("/podgroups/g1/status")
+        assert body["status"]["phase"] == "Running"
+
+    def test_scheduler_end_to_end_against_fake_api(self, fake):
+        """The kind-e2e analog: the full scheduler drives a gang through
+        the REST protocol — list, watch, gang gate, Binding subresource —
+        and the pods come back Running via watch events."""
+        fake.create("Queue", {
+            "apiVersion": f"{GROUP}/v1alpha1", "kind": "Queue",
+            "metadata": {"name": "default"}, "spec": {"weight": 1},
+        })
+        fake.create("PodGroup", {
+            "apiVersion": f"{GROUP}/v1alpha1", "kind": "PodGroup",
+            "metadata": {"name": "g1", "namespace": "default"},
+            "spec": {"minMember": 2, "queue": "default"},
+        })
+        fake.create("Node", node_doc("n1"))
+        for i in range(2):
+            fake.create("Pod", pod_doc(f"p{i}", group="g1"))
+
+        cluster = make_cluster(fake)
+        cache = SchedulerCache(cluster=cluster)
+        sched = Scheduler(cache, schedule_period=0.05)
+        stop = threading.Event()
+        t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+        t.start()
+        deadline = time.time() + 20
+        ok = False
+        while time.time() < deadline:
+            with fake.lock:
+                pods = list(fake.objects["Pod"].values())
+            if len(fake.bindings) >= 2 and all(
+                p["status"]["phase"] == "Running" for p in pods
+            ):
+                ok = True
+                break
+            time.sleep(0.05)
+        stop.set()
+        cluster.stop()
+        t.join(timeout=5)
+        assert ok, fake.bindings
+        assert {b[1] for b in fake.bindings} == {"n1"}
