@@ -20,6 +20,7 @@ import yaml
 
 from ..api import GROUP_NAME_ANNOTATION_KEY, PodPhase, PriorityClass
 from ..api.objects import (
+    SCHEDULING_GROUP,  # re-exported: the loader's public group constant
     Affinity,
     Container,
     Node,
@@ -36,8 +37,6 @@ from ..api.objects import (
     Toleration,
 )
 from ..cluster import InProcessCluster
-
-from ..api.objects import SCHEDULING_GROUP  # noqa: E402 (re-export)
 
 SUPPORTED_VERSIONS = ("v1alpha1", "v1alpha2")
 

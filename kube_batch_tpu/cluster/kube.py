@@ -92,14 +92,25 @@ class KubeConfig:
         if ctx is None:
             raise ValueError(f"kubeconfig {path}: no current-context")
         cluster = next(
-            c["cluster"] for c in cfg.get("clusters", [])
-            if c.get("name") == ctx["cluster"]
+            (c["cluster"] for c in cfg.get("clusters", [])
+             if c.get("name") == ctx["cluster"]),
+            None,
         )
+        if cluster is None:
+            raise ValueError(
+                f"kubeconfig {path}: cluster {ctx['cluster']!r} not found"
+            )
         user = next(
             (u["user"] for u in cfg.get("users", [])
              if u.get("name") == ctx.get("user")),
             {},
         )
+        if "exec" in user or "auth-provider" in user:
+            raise ValueError(
+                f"kubeconfig {path}: exec/auth-provider credentials are "
+                "not supported by the stdlib adapter; use a static token "
+                "or client certificate (e.g. a ServiceAccount token)"
+            )
         server = cluster["server"]
         sslctx = None
         if server.startswith("https"):
@@ -310,12 +321,16 @@ class KubeCluster(ClusterAPI):
         path, _ = RESOURCES[kind]
         rv = ""
         first = True
+        consecutive_failures = 0
         while not self._stop.is_set():
             if not rv and not first:
                 try:
                     rv = self._relist(kind)
                 except Exception as e:
-                    logger.debug("relist %s failed: %s", kind, e)
+                    consecutive_failures += 1
+                    self._log_watch_failure(
+                        kind, "relist", e, consecutive_failures
+                    )
                     self._stop.wait(self.reconnect_delay)
                     continue
             first = False
@@ -335,6 +350,7 @@ class KubeCluster(ClusterAPI):
                     timeout=self.watch_timeout,
                     context=self.config.ssl_context,
                 )
+                consecutive_failures = 0  # connection accepted
                 for line in resp:
                     if self._stop.is_set():
                         return
@@ -360,8 +376,27 @@ class KubeCluster(ClusterAPI):
             except Exception as e:
                 if self._stop.is_set():
                     return
-                logger.debug("watch %s disconnected: %s", kind, e)
+                consecutive_failures += 1
+                self._log_watch_failure(
+                    kind, "watch", e, consecutive_failures
+                )
             self._stop.wait(self.reconnect_delay)
+
+    _FAILURE_WARN_AFTER = 3
+
+    def _log_watch_failure(self, kind, phase, err, consecutive) -> None:
+        """Transient disconnects are DEBUG noise, but persistent failures
+        (RBAC 403, missing CRD 404, expired token 401) mean the scheduler
+        is running on a frozen view of that kind — escalate so the
+        operator sees it."""
+        if consecutive >= self._FAILURE_WARN_AFTER:
+            logger.warning(
+                "%s %s failed %d times in a row (%s); the scheduler's "
+                "view of %s objects is stale until this recovers",
+                phase, kind, consecutive, err, kind,
+            )
+        else:
+            logger.debug("%s %s disconnected: %s", phase, kind, err)
 
     # -- writes (the scheduler's side effects) ------------------------------
 

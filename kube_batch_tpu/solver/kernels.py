@@ -285,6 +285,12 @@ SCORE_QUANTUM = 0.02
 _KEY_HASH_BITS = 10
 _KEY_BIAS = 1 << 19  # centers the quantized range so negative scores rank
 
+# Conflict-resolution commits per score pass (see _solve_round): each
+# extra commit costs two O(T log T) sorts against the round's one
+# O(T*N) score matrix, and lets prefix-race losers cascade to their
+# next-best node without waiting for the next round.
+COMMITS_PER_ROUND = 3
+
 
 def _bid_hash(t_idx: jnp.ndarray, n_idx: jnp.ndarray) -> jnp.ndarray:
     """Decorrelated per-(task, node) hash in [0, 2^_KEY_HASH_BITS)."""
@@ -496,32 +502,67 @@ def _solve_round(
         )
         failed = failed | (task_ok & ~any_feas & ~fits_releasing)
         bid = jnp.where(blocked_of(failed), N, bid)
-    else:
-        fits = less_equal(task_fit[:, None, :], idle[None, :, :], eps)
-        mask = fits & feas & cap_ok[None, :] & task_ok[:, None]
-        failed = failed | (
-            task_ok & ~jnp.any(mask, axis=1) & ~fits_releasing
+        assigned, idle, ntask, qalloc, any_accept = _commit_bids(
+            bid, assigned, idle, ntask, qalloc,
+            task_req=task_req, task_fit=task_fit,
+            task_rank=task_rank, task_queue=task_queue,
+            node_max_tasks=node_max_tasks,
+            queue_deserved=queue_deserved, eps=eps,
         )
-        mask = mask & ~blocked_of(failed)[:, None]
-        score = (
-            dynamic_scores(task_req, idle, node_cap, lr_weight, br_weight)
-            + static_score
-        )
-        key = bid_keys(
-            score, task_ids[:, None], jnp.arange(N, dtype=jnp.int32)[None, :]
-        )
-        key = jnp.where(mask, key, -1)
+        return assigned, idle, ntask, qalloc, failed, any_accept
+
+    fits = less_equal(task_fit[:, None, :], idle[None, :, :], eps)
+    mask = fits & feas & cap_ok[None, :] & task_ok[:, None]
+    failed = failed | (
+        task_ok & ~jnp.any(mask, axis=1) & ~fits_releasing
+    )
+    mask = mask & ~blocked_of(failed)[:, None]
+    score = (
+        dynamic_scores(task_req, idle, node_cap, lr_weight, br_weight)
+        + static_score
+    )
+    key = bid_keys(
+        score, task_ids[:, None], jnp.arange(N, dtype=jnp.int32)[None, :]
+    )
+    key = jnp.where(mask, key, -1)
+
+    # Multi-commit: the [T, N] score/mask pass above is the round's
+    # expensive part (O(T*N)); conflict resolution is only O(T log T)
+    # sorts. Reusing one score matrix for several commits lets a bidder
+    # that lost a node's prefix race cascade to its next-best column in
+    # the SAME round — fits, pod counts, and queue budgets are re-checked
+    # exactly inside every _commit_bids against the updated idle/qalloc,
+    # so staleness only affects choice quality (caught by the fit check),
+    # never feasibility. Cuts full-width rounds roughly in proportion.
+    arange_t = jnp.arange(task_req.shape[0], dtype=jnp.int32)
+
+    def commit_once(_, state):
+        assigned, idle, ntask, qalloc, any_acc, key = state
+        live = (assigned < 0)
+        key_eff = jnp.where(live[:, None], key, -1)
+        has_bid = jnp.any(key_eff >= 0, axis=1)
         bid = jnp.where(
-            jnp.any(mask, axis=1),
-            jnp.argmax(key, axis=1).astype(jnp.int32),
-            N,
+            has_bid, jnp.argmax(key_eff, axis=1).astype(jnp.int32), N
         )
-    assigned, idle, ntask, qalloc, any_accept = _commit_bids(
-        bid, assigned, idle, ntask, qalloc,
-        task_req=task_req, task_fit=task_fit,
-        task_rank=task_rank, task_queue=task_queue,
-        node_max_tasks=node_max_tasks,
-        queue_deserved=queue_deserved, eps=eps,
+        assigned, idle, ntask, qalloc, acc = _commit_bids(
+            bid, assigned, idle, ntask, qalloc,
+            task_req=task_req, task_fit=task_fit,
+            task_rank=task_rank, task_queue=task_queue,
+            node_max_tasks=node_max_tasks,
+            queue_deserved=queue_deserved, eps=eps,
+        )
+        # Losers stop re-bidding the column they just lost this round
+        # (fresh scores next round may still pick it).
+        lost = has_bid & (assigned < 0)
+        col = jnp.where(has_bid, bid, 0)
+        key = key.at[arange_t, col].set(
+            jnp.where(lost, -1, key[arange_t, col])
+        )
+        return assigned, idle, ntask, qalloc, any_acc | acc, key
+
+    assigned, idle, ntask, qalloc, any_accept, _ = lax.fori_loop(
+        0, COMMITS_PER_ROUND, commit_once,
+        (assigned, idle, ntask, qalloc, jnp.asarray(False), key),
     )
     return assigned, idle, ntask, qalloc, failed, any_accept
 
