@@ -56,11 +56,17 @@ class ResourceLayout:
     def for_session(cls, ssn) -> "ResourceLayout":
         names = set()
         for node in ssn.nodes.values():
-            names.update(node.allocatable.scalar_resources or {})
+            sr = node.allocatable.scalar_resources
+            if sr:
+                names.update(sr)
         for job in ssn.jobs.values():
             for task in job.tasks.values():
-                names.update(task.resreq.scalar_resources or {})
-                names.update(task.init_resreq.scalar_resources or {})
+                sr = task.resreq.scalar_resources
+                if sr:
+                    names.update(sr)
+                sr = task.init_resreq.scalar_resources
+                if sr:
+                    names.update(sr)
         return cls(sorted(names))
 
     def vec(self, r: Resource) -> np.ndarray:
