@@ -189,6 +189,59 @@ class LeaderElector:
                 pass
 
 
+class KubeLeaseElector(LeaderElector):
+    """Leader election over a coordination/v1 Lease in the API server —
+    the reference's ConfigMap resourcelock analog (server.go:113-141),
+    giving cross-HOST failover in real-cluster mode where the file lease
+    only covers processes sharing a disk. Reuses LeaderElector's
+    acquire/renew loop; only the CAS differs (API-server resourceVersion
+    instead of an flock'd file)."""
+
+    def __init__(
+        self,
+        cluster,
+        namespace: str,
+        identity: str,
+        name: str = "tpu-batch",
+        lease_duration: float = LEASE_DURATION,
+        renew_deadline: float = RENEW_DEADLINE,
+        retry_period: float = RETRY_PERIOD,
+    ):
+        self.cluster = cluster
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self._renew_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.is_leader = False
+
+    def try_acquire(self) -> bool:
+        try:
+            self.is_leader = self.cluster.try_acquire_lease(
+                self.namespace, self.name, self.identity,
+                self.lease_duration,
+            )
+        except Exception:
+            # Transient API failure: this attempt fails; the renew loop's
+            # renew_deadline decides when failing attempts lose leadership.
+            logger.exception("lease acquire attempt failed")
+            self.is_leader = False
+        return self.is_leader
+
+    def release(self) -> None:
+        self._stop.set()
+        if self.is_leader:
+            # Clear the holder so a successor (new hostname-pid identity
+            # after a rolling restart) does not wait out lease_duration.
+            self.cluster.release_lease(
+                self.namespace, self.name, self.identity
+            )
+            self.is_leader = False
+
+
 def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
         stop_event: Optional[threading.Event] = None) -> None:
     """reference app/server.go:63-141 Run."""
@@ -246,10 +299,19 @@ def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
             return
 
         opt.check_option_or_die()
-        elector = LeaderElector(
-            opt.lock_object_namespace,
-            identity=f"{os.uname().nodename}-{os.getpid()}",
-        )
+        identity = f"{os.uname().nodename}-{os.getpid()}"
+        if getattr(cluster, "supports_lease_election", False):
+            # Real-cluster mode: the lock object lives in the API server
+            # (coordination/v1 Lease — the reference's ConfigMap
+            # resourcelock analog, server.go:113-141), so failover works
+            # across hosts, not just processes on one machine.
+            elector = KubeLeaseElector(
+                cluster, opt.lock_object_namespace, identity=identity
+            )
+        else:
+            elector = LeaderElector(
+                opt.lock_object_namespace, identity=identity
+            )
         try:
             elector.run(
                 on_started_leading=run_scheduler,

@@ -42,6 +42,12 @@ WatchHandler = Callable[[str, str, object], None]  # (kind, event_type, obj)
 class ClusterAPI:
     """Contract between the scheduler cache and the cluster substrate."""
 
+    # Real-cluster implementations that expose try_acquire_lease /
+    # release_lease (API-server-backed leader election) set this True;
+    # the server then uses cross-host Lease election instead of the
+    # single-host file lock.
+    supports_lease_election = False
+
     # -- reads / watches ----------------------------------------------------
 
     def list_objects(self, kind: str) -> List[object]:

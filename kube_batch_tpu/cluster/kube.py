@@ -194,8 +194,41 @@ def _to_domain(kind: str, obj: dict):
     return domain
 
 
+def _now_rfc3339() -> str:
+    """MicroTime serialization: exactly 6 fractional digits (strict k8s
+    RFC3339Micro decoders reject anything else)."""
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%fZ"
+    )
+
+
+def _parse_rfc3339(s: str):
+    """Tolerant RFC3339 parse: any writer's fractional precision (0, 3,
+    6, or 9 digits) must parse — a parse FAILURE on a live foreign lease
+    would read as 'expired' and cause a split-brain steal."""
+    import datetime
+
+    if not s:
+        return None
+    s = s.strip()
+    if s.endswith("Z"):
+        s = s[:-1]
+    base, _, frac = s.partition(".")
+    frac = (frac[:6]).ljust(6, "0") if frac else "000000"
+    try:
+        return datetime.datetime.strptime(
+            f"{base}.{frac}", "%Y-%m-%dT%H:%M:%S.%f"
+        ).replace(tzinfo=datetime.timezone.utc)
+    except ValueError:
+        return None
+
+
 class KubeCluster(ClusterAPI):
     """ClusterAPI over a real Kubernetes API server."""
+
+    supports_lease_election = True
 
     WATCH_KINDS = (
         "Pod", "Node", "PodGroup", "Queue", "PriorityClass",
@@ -465,6 +498,100 @@ class KubeCluster(ClusterAPI):
             }},
             content_type="application/merge-patch+json",
         )
+
+    # -- leader election (coordination.k8s.io Lease) -------------------------
+
+    LEASE_PATH = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}"
+    LEASES_PATH = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+
+    def try_acquire_lease(self, namespace: str, name: str, identity: str,
+                          lease_duration: float) -> bool:
+        """One compare-and-swap attempt on a coordination/v1 Lease — the
+        analog of the reference's ConfigMap resource lock
+        (server.go:113-141). Optimistic concurrency rides the API
+        server's resourceVersion: a concurrent steal makes our PUT/POST
+        409 and the attempt simply fails (the caller retries on its
+        retry period)."""
+        import datetime
+
+        now_rfc3339 = _now_rfc3339
+        parse_rfc3339 = _parse_rfc3339
+
+        item = self.LEASE_PATH.format(ns=namespace, name=name)
+        try:
+            lease = self._request("GET", item)
+        except urlerror.HTTPError as e:
+            if e.code != 404:
+                raise
+            try:
+                self._request(
+                    "POST", self.LEASES_PATH.format(ns=namespace), body={
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {"name": name, "namespace": namespace},
+                        "spec": {
+                            "holderIdentity": identity,
+                            "leaseDurationSeconds": int(lease_duration),
+                            "acquireTime": now_rfc3339(),
+                            "renewTime": now_rfc3339(),
+                            "leaseTransitions": 0,
+                        },
+                    })
+                return True
+            except urlerror.HTTPError as ce:
+                if ce.code == 409:  # lost the creation race
+                    return False
+                raise
+
+        spec = lease.get("spec", {}) or {}
+        holder = spec.get("holderIdentity", "")
+        renew = parse_rfc3339(spec.get("renewTime", ""))
+        now = datetime.datetime.now(datetime.timezone.utc)
+        expired = renew is None or (
+            (now - renew).total_seconds() > lease_duration
+        )
+        if holder and holder != identity and not expired:
+            return False
+        transitions = int(spec.get("leaseTransitions") or 0)
+        new_spec = {
+            **spec,
+            "holderIdentity": identity,
+            "leaseDurationSeconds": int(lease_duration),
+            "renewTime": now_rfc3339(),
+        }
+        if holder != identity:
+            # Leadership transition: stamp the new acquisition (client-go
+            # resourcelock behavior) so lease-age tooling stays truthful.
+            new_spec["leaseTransitions"] = transitions + 1
+            new_spec["acquireTime"] = now_rfc3339()
+        else:
+            new_spec["leaseTransitions"] = transitions
+        lease["spec"] = new_spec
+        try:
+            # Full PUT carrying the GET's resourceVersion: a concurrent
+            # writer bumps it and this update 409s.
+            self._request("PUT", item, body=lease)
+            return True
+        except urlerror.HTTPError as e:
+            if e.code == 409:
+                return False
+            raise
+
+    def release_lease(self, namespace: str, name: str, identity: str) -> None:
+        """Relinquish a held lease on graceful shutdown (client-go
+        ReleaseOnCancel: clear holderIdentity so a successor need not
+        wait out lease_duration). Best-effort — losing the CAS race here
+        just means someone already took it."""
+        item = self.LEASE_PATH.format(ns=namespace, name=name)
+        try:
+            lease = self._request("GET", item)
+            spec = lease.get("spec", {}) or {}
+            if spec.get("holderIdentity") != identity:
+                return
+            lease["spec"] = {**spec, "holderIdentity": ""}
+            self._request("PUT", item, body=lease)
+        except Exception:
+            logger.debug("lease release failed", exc_info=True)
 
     def record_event(self, obj, event_type: str, reason: str,
                      message: str) -> None:

@@ -65,6 +65,7 @@ class FakeKube:
         self.subscribers = {kind: [] for kind in self.PATHS.values()}
         self.bindings = []
         self.status_patches = []
+        self.leases = {}
         self.lock = threading.RLock()
         self.rv = 0
 
@@ -92,6 +93,14 @@ class FakeKube:
                 path, _, qs = self.path.partition("?")
                 kind = fake.PATHS.get(path)
                 if kind is None:
+                    if "/leases/" in path:
+                        with fake.lock:
+                            lease = fake.leases.get(path)
+                        if lease is None:
+                            self._json(404, {"kind": "Status", "code": 404})
+                        else:
+                            self._json(200, lease)
+                        return
                     # Item GET: /api/v1/namespaces/{ns}/pods/{name}
                     if "/namespaces/" in path:
                         parts = path.split("/")
@@ -143,6 +152,19 @@ class FakeKube:
                 })
 
             def do_POST(self):
+                if self.path.endswith("/leases"):
+                    body = self._read_body()
+                    name = body["metadata"]["name"]
+                    key = f"{self.path}/{name}"
+                    with fake.lock:
+                        if key in fake.leases:
+                            self._json(409, {"kind": "Status", "code": 409})
+                            return
+                        fake.rv += 1
+                        body["metadata"]["resourceVersion"] = str(fake.rv)
+                        fake.leases[key] = body
+                    self._json(201, body)
+                    return
                 if self.path.endswith("/binding"):
                     body = self._read_body()
                     parts = self.path.split("/")
@@ -169,6 +191,28 @@ class FakeKube:
                 with fake.lock:
                     fake.status_patches.append((self.path, body))
                 self._json(200, {"kind": "Status", "status": "Success"})
+
+            def do_PUT(self):
+                if "/leases/" not in self.path:
+                    self._json(404, {"code": 404})
+                    return
+                body = self._read_body()
+                with fake.lock:
+                    stored = fake.leases.get(self.path)
+                    if stored is None:
+                        self._json(404, {"code": 404})
+                        return
+                    # Optimistic concurrency: resourceVersion must match.
+                    if (
+                        body.get("metadata", {}).get("resourceVersion")
+                        != stored["metadata"]["resourceVersion"]
+                    ):
+                        self._json(409, {"kind": "Status", "code": 409})
+                        return
+                    fake.rv += 1
+                    body["metadata"]["resourceVersion"] = str(fake.rv)
+                    fake.leases[self.path] = body
+                self._json(200, body)
 
             def do_DELETE(self):
                 parts = self.path.split("/")
@@ -318,3 +362,92 @@ class TestKubeCluster:
         t.join(timeout=5)
         assert ok, fake.bindings
         assert {b[1] for b in fake.bindings} == {"n1"}
+
+
+class TestLeaseElection:
+    """coordination/v1 Lease lock (reference server.go:113-141 ConfigMap
+    resourcelock analog): CAS via resourceVersion, steal on expiry."""
+
+    def test_acquire_creates_lease(self, fake):
+        cluster = make_cluster(fake)
+        assert cluster.try_acquire_lease("kube-system", "tb", "me", 15.0)
+        lease = list(fake.leases.values())[0]
+        assert lease["spec"]["holderIdentity"] == "me"
+
+    def test_fresh_foreign_lease_blocks(self, fake):
+        cluster = make_cluster(fake)
+        assert cluster.try_acquire_lease("kube-system", "tb", "a", 15.0)
+        assert not cluster.try_acquire_lease("kube-system", "tb", "b", 15.0)
+        # ...but the holder itself renews fine (transitions unchanged).
+        assert cluster.try_acquire_lease("kube-system", "tb", "a", 15.0)
+        lease = list(fake.leases.values())[0]
+        assert lease["spec"]["leaseTransitions"] == 0
+
+    def test_expired_lease_is_stolen(self, fake):
+        cluster = make_cluster(fake)
+        assert cluster.try_acquire_lease("kube-system", "tb", "a", 0.05)
+        time.sleep(0.1)
+        assert cluster.try_acquire_lease("kube-system", "tb", "b", 0.05)
+        lease = list(fake.leases.values())[0]
+        assert lease["spec"]["holderIdentity"] == "b"
+        assert lease["spec"]["leaseTransitions"] == 1
+
+    def test_concurrent_steal_loses_cas(self, fake):
+        # Simulate a racing writer bumping resourceVersion between our
+        # GET and PUT: stale PUT must 409 -> attempt fails.
+        cluster = make_cluster(fake)
+        assert cluster.try_acquire_lease("kube-system", "tb", "a", 0.01)
+        time.sleep(0.05)
+        orig_request = cluster._request
+
+        def racing_request(method, path, body=None, **kw):
+            out = orig_request(method, path, body=body, **kw)
+            if method == "GET" and "/leases/" in path:
+                with fake.lock:  # racer steals right after our GET
+                    key = next(iter(fake.leases))
+                    fake.rv += 1
+                    fake.leases[key]["metadata"]["resourceVersion"] = str(
+                        fake.rv
+                    )
+            return out
+
+        cluster._request = racing_request
+        assert not cluster.try_acquire_lease("kube-system", "tb", "b", 0.01)
+
+    def test_kube_lease_elector_roundtrip(self, fake):
+        from kube_batch_tpu.cli.server import KubeLeaseElector
+
+        cluster = make_cluster(fake)
+        a = KubeLeaseElector(cluster, "kube-system", identity="a",
+                             lease_duration=15.0)
+        b = KubeLeaseElector(cluster, "kube-system", identity="b",
+                             lease_duration=15.0)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert a.try_acquire()  # renew
+
+    def test_release_lets_successor_acquire_immediately(self, fake):
+        cluster = make_cluster(fake)
+        assert cluster.try_acquire_lease("kube-system", "tb", "a", 15.0)
+        cluster.release_lease("kube-system", "tb", "a")
+        lease = list(fake.leases.values())[0]
+        assert lease["spec"]["holderIdentity"] == ""
+        # Successor takes over without waiting out lease_duration.
+        assert cluster.try_acquire_lease("kube-system", "tb", "b", 15.0)
+
+    def test_timestamp_parse_tolerates_other_writers(self):
+        from kube_batch_tpu.cluster.kube import _parse_rfc3339
+
+        # Zero, milli, micro, and nano fractional digits must all parse —
+        # a parse failure reads as 'expired' and would split-brain.
+        for ts in (
+            "2026-07-29T12:34:56Z",
+            "2026-07-29T12:34:56.123Z",
+            "2026-07-29T12:34:56.123456Z",
+            "2026-07-29T12:34:56.123456789Z",
+        ):
+            parsed = _parse_rfc3339(ts)
+            assert parsed is not None, ts
+            assert parsed.second == 56
+        assert _parse_rfc3339("") is None
+        assert _parse_rfc3339("garbage") is None
