@@ -10,7 +10,7 @@ all: native
 
 # Native components (greedy baseline / CPU fallback).
 native:
-	$(MAKE) -C native
+	$(MAKE) -C kube_batch_tpu/native/csrc
 
 # Unit + action + solver + e2e suites on the virtual CPU mesh.
 test:
@@ -46,5 +46,5 @@ image:
 	docker build -f deployment/images/Dockerfile -t tpu-batch:latest .
 
 clean:
-	$(MAKE) -C native clean
+	$(MAKE) -C kube_batch_tpu/native/csrc clean
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

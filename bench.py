@@ -5,7 +5,7 @@ Reproduces the BASELINE.json synthetic configs (1k pods x 100 nodes,
 10k x 1k, 50k x 5k gang mix) through the REAL pipeline: SchedulerCache event
 ingest -> Session open (plugins) -> tensorize -> batched TPU solve. The
 baseline is the NATIVE (C++) reimplementation of the reference's greedy
-allocate loop (native/greedy.cpp), measured outright at the headline scale
+allocate loop (kube_batch_tpu/native/csrc/greedy.cpp), measured outright at the headline scale
 on the same snapshot arrays — the fair stand-in for the reference's
 compiled Go loop. The Python greedy action is also timed on the small
 config as a sanity datapoint (and as extrapolation fallback when no
@@ -155,7 +155,7 @@ def bench_greedy(cfg, seed=0):
 
 def bench_native_greedy(inputs, repeats=2):
     """Measured native (C++) reference-loop baseline on the SAME snapshot
-    arrays the TPU solver consumes (native/greedy.cpp) — the fair stand-in
+    arrays the TPU solver consumes (csrc/greedy.cpp) — the fair stand-in
     for the reference's compiled Go loop. Returns (seconds, placed) or
     None when no toolchain is available."""
     try:

@@ -27,6 +27,7 @@ import os
 
 import numpy as np
 
+from .. import metrics
 from ..framework import Action, register_action
 from ..solver import solve_sharded, tensorize
 from ..utils.scheduler_helper import prioritize_nodes, select_best_node
@@ -78,6 +79,7 @@ class AllocateTpuAction(Action):
 
             assigned, _ = solve_native(inputs)
             rounds = 1
+            backend = "native"
         else:
             # solve_sharded shards the node axis over all visible devices
             # (the multi-chip scale path) and falls back to the cached
@@ -85,6 +87,10 @@ class AllocateTpuAction(Action):
             result = solve_sharded(inputs, max_rounds=self.max_rounds)
             assigned = np.asarray(result.assigned)
             rounds = int(result.rounds)
+            import jax
+
+            backend = f"jax-{jax.devices()[0].platform}"
+        metrics.update_solver_cycle(rounds, backend)
 
         placed = 0
         # ctx.tasks is already in global priority-rank order.

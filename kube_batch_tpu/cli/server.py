@@ -219,6 +219,11 @@ class KubeLeaseElector(LeaderElector):
         self.is_leader = False
 
     def try_acquire(self) -> bool:
+        if self._stop.is_set():
+            # release() is clearing the lease: an in-flight renew must
+            # not re-acquire it for the dying identity.
+            self.is_leader = False
+            return False
         try:
             self.is_leader = self.cluster.try_acquire_lease(
                 self.namespace, self.name, self.identity,
@@ -233,9 +238,13 @@ class KubeLeaseElector(LeaderElector):
 
     def release(self) -> None:
         self._stop.set()
+        # Drain the renew loop BEFORE clearing the holder: a renew whose
+        # API call straddles the release would otherwise re-write
+        # holderIdentity after we cleared it, re-pinning the lease to a
+        # dying process for the full lease_duration.
+        if self._renew_thread is not None:
+            self._renew_thread.join(timeout=10.0)
         if self.is_leader:
-            # Clear the holder so a successor (new hostname-pid identity
-            # after a rolling restart) does not wait out lease_duration.
             self.cluster.release_lease(
                 self.namespace, self.name, self.identity
             )

@@ -25,12 +25,14 @@ loop is a few dozen lines instead of a generated informer stack.
 from __future__ import annotations
 
 import base64
+import datetime
 import json
 import logging
 import os
 import ssl
 import tempfile
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 from urllib import error as urlerror
 from urllib import request as urlrequest
@@ -197,32 +199,9 @@ def _to_domain(kind: str, obj: dict):
 def _now_rfc3339() -> str:
     """MicroTime serialization: exactly 6 fractional digits (strict k8s
     RFC3339Micro decoders reject anything else)."""
-    import datetime
-
     return datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%dT%H:%M:%S.%fZ"
     )
-
-
-def _parse_rfc3339(s: str):
-    """Tolerant RFC3339 parse: any writer's fractional precision (0, 3,
-    6, or 9 digits) must parse — a parse FAILURE on a live foreign lease
-    would read as 'expired' and cause a split-brain steal."""
-    import datetime
-
-    if not s:
-        return None
-    s = s.strip()
-    if s.endswith("Z"):
-        s = s[:-1]
-    base, _, frac = s.partition(".")
-    frac = (frac[:6]).ljust(6, "0") if frac else "000000"
-    try:
-        return datetime.datetime.strptime(
-            f"{base}.{frac}", "%Y-%m-%dT%H:%M:%S.%f"
-        ).replace(tzinfo=datetime.timezone.utc)
-    except ValueError:
-        return None
 
 
 class KubeCluster(ClusterAPI):
@@ -250,6 +229,9 @@ class KubeCluster(ClusterAPI):
         self._watch_threads: Dict[str, threading.Thread] = {}
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        # (namespace, name) -> ((holder, renewTime), local monotonic ts):
+        # locally-observed lease transitions for skew-safe expiry.
+        self._lease_observations: Dict = {}
 
     # -- HTTP ---------------------------------------------------------------
 
@@ -511,12 +493,14 @@ class KubeCluster(ClusterAPI):
         (server.go:113-141). Optimistic concurrency rides the API
         server's resourceVersion: a concurrent steal makes our PUT/POST
         409 and the attempt simply fails (the caller retries on its
-        retry period)."""
-        import datetime
+        retry period).
 
-        now_rfc3339 = _now_rfc3339
-        parse_rfc3339 = _parse_rfc3339
-
+        Expiry is judged by LOCALLY-OBSERVED renew transitions (client-go
+        leaderelection semantics): a foreign lease is expired only when
+        its (holder, renewTime) pair has not CHANGED for lease_duration
+        of local monotonic time. Comparing the remote renewTime against
+        the local wall clock would let a contender with a skewed clock
+        steal a live lease — split-brain."""
         item = self.LEASE_PATH.format(ns=namespace, name=name)
         try:
             lease = self._request("GET", item)
@@ -532,8 +516,8 @@ class KubeCluster(ClusterAPI):
                         "spec": {
                             "holderIdentity": identity,
                             "leaseDurationSeconds": int(lease_duration),
-                            "acquireTime": now_rfc3339(),
-                            "renewTime": now_rfc3339(),
+                            "acquireTime": _now_rfc3339(),
+                            "renewTime": _now_rfc3339(),
                             "leaseTransitions": 0,
                         },
                     })
@@ -545,11 +529,16 @@ class KubeCluster(ClusterAPI):
 
         spec = lease.get("spec", {}) or {}
         holder = spec.get("holderIdentity", "")
-        renew = parse_rfc3339(spec.get("renewTime", ""))
-        now = datetime.datetime.now(datetime.timezone.utc)
-        expired = renew is None or (
-            (now - renew).total_seconds() > lease_duration
-        )
+        record = (holder, spec.get("renewTime", ""))
+        obs_key = (namespace, name)
+        obs = self._lease_observations.get(obs_key)
+        now_mono = time.monotonic()
+        if obs is None or obs[0] != record:
+            # The record moved (or this is our first look): restart the
+            # local expiry clock.
+            self._lease_observations[obs_key] = (record, now_mono)
+            obs = self._lease_observations[obs_key]
+        expired = (now_mono - obs[1]) > lease_duration
         if holder and holder != identity and not expired:
             return False
         transitions = int(spec.get("leaseTransitions") or 0)
@@ -557,13 +546,13 @@ class KubeCluster(ClusterAPI):
             **spec,
             "holderIdentity": identity,
             "leaseDurationSeconds": int(lease_duration),
-            "renewTime": now_rfc3339(),
+            "renewTime": _now_rfc3339(),
         }
         if holder != identity:
             # Leadership transition: stamp the new acquisition (client-go
             # resourcelock behavior) so lease-age tooling stays truthful.
             new_spec["leaseTransitions"] = transitions + 1
-            new_spec["acquireTime"] = now_rfc3339()
+            new_spec["acquireTime"] = _now_rfc3339()
         else:
             new_spec["leaseTransitions"] = transitions
         lease["spec"] = new_spec

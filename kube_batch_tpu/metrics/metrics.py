@@ -169,6 +169,13 @@ pod_group_phase_count = REGISTRY.register(
 solver_iterations = REGISTRY.register(
     Gauge("solver_iterations", "TPU solver rounds used in the last cycle")
 )
+solver_backend_cycles = REGISTRY.register(
+    Counter(
+        "solver_backend_cycles",
+        "Cycles solved per backend (jax device vs native CPU fallback)",
+    ),
+    ("backend",),
+)
 
 
 # Update helpers (reference metrics.go:122-170).
@@ -211,3 +218,10 @@ def update_unschedulable_job_count(count: int) -> None:
 
 def register_job_retries(job_id: str) -> None:
     job_retry_count.inc((job_id,))
+
+
+def update_solver_cycle(rounds: int, backend: str) -> None:
+    """Record one allocate_tpu cycle: rounds used and which backend
+    solved it ("jax-<platform>" or "native")."""
+    solver_iterations.set(rounds)
+    solver_backend_cycles.inc((backend,))

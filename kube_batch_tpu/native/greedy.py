@@ -1,7 +1,11 @@
-"""ctypes wrapper for native/greedy.cpp (the reference loop baseline).
+"""ctypes wrapper for csrc/greedy.cpp (the reference loop baseline).
 
-Builds ``native/build/libgreedy.so`` with ``make -C native`` on first use
-(cached thereafter). numpy in, numpy out; see greedy.cpp for semantics.
+Builds ``libgreedy.so`` with the packaged Makefile on first use (cached
+thereafter). The source lives INSIDE the package (``csrc/``) so installed
+wheels carry the native fallback, not just repo checkouts; when the
+package directory is read-only (site-packages), the build lands in a
+per-user cache directory instead. numpy in, numpy out; see greedy.cpp
+for semantics.
 """
 
 from __future__ import annotations
@@ -9,16 +13,21 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import tempfile
 import threading
 from typing import Optional, Tuple
 
 import numpy as np
 
-_REPO_ROOT = os.path.dirname(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
-_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libgreedy.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+
+
+def _build_dirs():
+    """Candidate build output dirs, preferred first."""
+    yield os.path.join(_NATIVE_DIR, "build")
+    yield os.path.join(
+        tempfile.gettempdir(), f"tpu-batch-native-{os.getuid()}", "build"
+    )
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -36,26 +45,38 @@ def _load() -> ctypes.CDLL:
             return _lib
         if _load_error is not None:
             raise NativeUnavailable(_load_error)
-        try:
-            src = os.path.join(_NATIVE_DIR, "greedy.cpp")
-            # A prebuilt .so without sources (stripped deploy) must load
-            # as-is; rebuild only when the source is present and newer.
-            stale = not os.path.exists(_SO_PATH) or (
-                os.path.exists(src)
-                and os.path.getmtime(_SO_PATH) < os.path.getmtime(src)
-            )
-            if stale:
-                subprocess.run(
-                    ["make", "-B", "-C", _NATIVE_DIR],
-                    check=True,
-                    capture_output=True,
-                    text=True,
+        src = os.path.join(_NATIVE_DIR, "greedy.cpp")
+        last_err = None
+        lib = None
+        for build_dir in _build_dirs():
+            so_path = os.path.join(build_dir, "libgreedy.so")
+            try:
+                # A prebuilt .so without sources (stripped deploy) must
+                # load as-is; rebuild only when the source is present and
+                # newer.
+                stale = not os.path.exists(so_path) or (
+                    os.path.exists(src)
+                    and os.path.getmtime(so_path) < os.path.getmtime(src)
                 )
-            lib = ctypes.CDLL(_SO_PATH)
-        except (OSError, subprocess.CalledProcessError) as e:
-            detail = getattr(e, "stderr", "") or str(e)
+                if stale:
+                    os.makedirs(build_dir, exist_ok=True)
+                    subprocess.run(
+                        ["make", "-B", "-C", _NATIVE_DIR,
+                         f"BUILD={build_dir}"],
+                        check=True,
+                        capture_output=True,
+                        text=True,
+                    )
+                lib = ctypes.CDLL(so_path)
+                break
+            except (OSError, subprocess.CalledProcessError) as e:
+                # Read-only package dir (site-packages install): fall
+                # through to the per-user cache build.
+                last_err = e
+        if lib is None:
+            detail = getattr(last_err, "stderr", "") or str(last_err)
             _load_error = f"native greedy unavailable: {detail}"
-            raise NativeUnavailable(_load_error) from e
+            raise NativeUnavailable(_load_error) from last_err
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")

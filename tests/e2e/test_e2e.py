@@ -308,3 +308,100 @@ class TestTPUAllocate:
             ctx.create_and_submit(JobSpec(name="big", replicas=5))
             ctx.settle(cycles=3)
             assert len(ctx.running_pods("big")) == 0
+
+
+class TestChurnSoak:
+    def test_scheduler_converges_under_churn(self):
+        """Soak: pods stream in while others are deleted mid-flight, over
+        a live scheduler loop. Asserts the recovery story (SURVEY.md §5):
+        every surviving pod eventually Running, every deleted pod's
+        resources returned, cache node accounting == cluster truth."""
+        import threading
+        import time
+
+        from kube_batch_tpu.api import PodPhase, build_resource_list
+        from kube_batch_tpu.cache import SchedulerCache
+        from kube_batch_tpu.cluster import InProcessCluster
+        from kube_batch_tpu.scheduler import Scheduler
+        from kube_batch_tpu.utils.test_utils import (
+            build_node, build_pod, build_pod_group, build_queue,
+        )
+
+        cluster = InProcessCluster(simulate_kubelet=True)
+        cluster.create("Queue", build_queue("default"))
+        for j in range(4):
+            cluster.create("Node", build_node(
+                f"n{j}", build_resource_list(cpu="16", memory="32Gi", pods=60)
+            ))
+        cache = SchedulerCache(cluster=cluster)
+        sched = Scheduler(cache, schedule_period=0.02)
+        stop = threading.Event()
+        t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+        t.start()
+
+        survivors = []
+        deleted = []
+        for wave in range(6):
+            pg = f"pg{wave}"
+            cluster.create("PodGroup", build_pod_group(
+                pg, namespace="soak", min_member=2, queue="default"
+            ))
+            pods = [
+                build_pod("soak", f"{pg}-p{i}", "", PodPhase.PENDING,
+                          build_resource_list(cpu="500m", memory="512Mi"),
+                          group_name=pg)
+                for i in range(4)
+            ]
+            for p in pods:
+                cluster.create("Pod", p)
+            time.sleep(0.05)
+            # Delete one pod of every EVEN wave mid-flight (it may be
+            # Pending, Binding, or already Running).
+            if wave % 2 == 0:
+                cluster.delete_pod(pods[0])
+                deleted.append(pods[0])
+                survivors.extend(pods[1:])
+            else:
+                survivors.extend(pods)
+
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            live = cluster.list_objects("Pod")
+            names = {p.metadata.name for p in live}
+            if (
+                len(live) == len(survivors)
+                and all(p.status.phase == PodPhase.RUNNING for p in live)
+                and all(p.metadata.name in names for p in survivors)
+            ):
+                ok = True
+                break
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=5)
+        assert ok, [
+            (p.metadata.name, p.status.phase, p.spec.node_name)
+            for p in cluster.list_objects("Pod")
+        ]
+        # Deleted pods' resources were returned: cache node accounting
+        # must equal the sum of the cluster's surviving assignments.
+        cache.wait_for_side_effects()
+        per_node = {}
+        for p in cluster.list_objects("Pod"):
+            per_node.setdefault(p.spec.node_name, 0.0)
+            per_node[p.spec.node_name] += 500.0
+        deadline = time.time() + 10
+        consistent = False
+        while time.time() < deadline:
+            with cache.mutex:
+                used = {
+                    name: n.used.milli_cpu for name, n in cache.nodes.items()
+                }
+            if all(
+                abs(used.get(name, 0.0) - cpu) < 1e-6
+                for name, cpu in per_node.items()
+            ) and sum(used.values()) == sum(per_node.values()):
+                consistent = True
+                break
+            time.sleep(0.05)
+        assert consistent, (used, per_node)
