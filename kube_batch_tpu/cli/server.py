@@ -221,8 +221,9 @@ class KubeLeaseElector(LeaderElector):
     def try_acquire(self) -> bool:
         if self._stop.is_set():
             # release() is clearing the lease: an in-flight renew must
-            # not re-acquire it for the dying identity.
-            self.is_leader = False
+            # not re-acquire it for the dying identity. is_leader stays
+            # untouched — release() still needs it true to know the
+            # holder must be cleared.
             return False
         try:
             self.is_leader = self.cluster.try_acquire_lease(

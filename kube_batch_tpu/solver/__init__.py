@@ -26,6 +26,7 @@ from .kernels import (
 from .masks import BatchMask, CombinedMask, combine_masks, combine_score_rows
 from .sharding import (
     default_mesh,
+    init_distributed,
     pad_nodes,
     sharded_step,
     shardings_for,

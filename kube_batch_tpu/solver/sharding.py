@@ -37,6 +37,47 @@ _NODE_MINOR = ("group_feas", "pair_feas", "score_rows")
 _PACKED_NODE_MINOR = ("node_f32", "node_i32") + _NODE_MINOR
 
 
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Join a multi-HOST jax runtime (DCN scale-out) before building the
+    mesh. After this, ``jax.devices()`` spans every host's chips and
+    ``default_mesh()``/``solve_sharded`` work unchanged — XLA lays intra-
+    host collectives on ICI and inter-host legs on DCN under GSPMD; the
+    solver code has no host awareness at all.
+
+    SPMD contract: EVERY process of the distributed runtime must execute
+    every sharded solve (jax multi-process collectives block until all
+    participants arrive). This is therefore an API for symmetric solver
+    deployments — e.g. a dedicated solver job whose replicas all call
+    ``solve_sharded`` on identical inputs — NOT for scheduler replicas
+    behind leader election, where only the leader would solve and the
+    first collective would deadlock. The scheduler server deliberately
+    does not auto-join a distributed runtime for that reason.
+
+    Parameters default to the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES
+    / JAX_PROCESS_ID environment (the jax.distributed convention). No-op
+    when no coordinator is configured (single-host mode)."""
+    import os
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if not coordinator_address:
+        return False
+    if num_processes is None:
+        env_n = os.environ.get("JAX_NUM_PROCESSES", "")
+        num_processes = int(env_n) if env_n else None
+    if process_id is None:
+        env_id = os.environ.get("JAX_PROCESS_ID", "")
+        process_id = int(env_id) if env_id else None
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
 def default_mesh(devices=None):
     """A 1-D node-axis mesh over ``devices`` (default: all visible
     devices), or None when only one device exists (single-chip solves

@@ -8,6 +8,8 @@ shapes, the staged solver, ragged node counts (padding), and the
 PackedInputs transfer format produced by ``tensorize``.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -180,3 +182,66 @@ class TestShardedSnapshotPath:
             assert int((np.asarray(sharded.assigned) >= 0).sum()) == 24
         finally:
             close_session(ssn)
+
+
+def test_init_distributed_single_process_roundtrip():
+    """Multi-host hook: a 1-process distributed jax runtime (CPU) must
+    initialize from env and run the sharded solve unchanged — validates
+    the DCN scale-out entry point without multiple hosts. Runs in a
+    SUBPROCESS because jax.distributed.initialize is irreversible
+    per-process."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = """
+import os
+os.environ["JAX_COORDINATOR_ADDRESS"] = "127.0.0.1:%d"
+os.environ["JAX_NUM_PROCESSES"] = "1"
+os.environ["JAX_PROCESS_ID"] = "0"
+# distributed init must precede ANY backend resolution (jax.devices
+# included), so request the virtual devices via env only, then join.
+from kube_batch_tpu.utils.backend import set_host_device_count
+set_host_device_count(4)
+from kube_batch_tpu.solver import default_mesh, init_distributed, solve_sharded
+assert init_distributed()
+import jax, jax.numpy as jnp
+from kube_batch_tpu.solver import make_inputs
+mesh = default_mesh()
+assert mesh is not None, jax.devices()
+T, N = 8, 8
+inputs = make_inputs(
+    task_req=jnp.full((T, 2), 100.0),
+    task_fit=jnp.full((T, 2), 100.0),
+    task_rank=jnp.arange(T, dtype=jnp.int32),
+    task_job=jnp.arange(T, dtype=jnp.int32),
+    task_queue=jnp.zeros(T, jnp.int32),
+    node_idle=jnp.full((N, 2), 400.0),
+    node_releasing=jnp.zeros((N, 2)),
+    node_cap=jnp.full((N, 2), 400.0),
+    node_task_count=jnp.zeros(N, jnp.int32),
+    node_max_tasks=jnp.zeros(N, jnp.int32),
+    queue_deserved=jnp.full((1, 2), jnp.inf),
+    queue_allocated=jnp.zeros((1, 2)),
+    eps=jnp.full((2,), 10.0),
+    lr_weight=jnp.asarray(1.0),
+    br_weight=jnp.asarray(1.0),
+)
+res = solve_sharded(inputs, mesh)
+import numpy as np
+assert (np.asarray(res.assigned) >= 0).all()
+print("DISTRIBUTED_OK")
+""" % port
+    env = dict(os.environ)
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"})
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=180, env=env, cwd=os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+    )
+    assert "DISTRIBUTED_OK" in out.stdout, (out.stdout, out.stderr[-2000:])
