@@ -58,6 +58,7 @@ __all__ = [
     "solve_auto",
     "solve_full_jit",
     "solve_jit",
+    "init_distributed",
     "solve_sharded",
     "solve_staged",
     "solve_staged_jit",

@@ -64,6 +64,11 @@ def init_distributed(coordinator_address=None, num_processes=None,
     )
     if not coordinator_address:
         return False
+    # Idempotent: a retry path or second defensive join must not crash
+    # (jax.distributed.initialize raises if called twice).
+    state = getattr(jax._src.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return True
     if num_processes is None:
         env_n = os.environ.get("JAX_NUM_PROCESSES", "")
         num_processes = int(env_n) if env_n else None

@@ -11,6 +11,15 @@ points.
 
 import os
 
+# Import pallas BEFORE the backend purge: its checkify lowering rules
+# register against the "tpu" platform, which force_cpu_devices is about
+# to deregister — importing later raises NotImplementedError and the
+# interpret-mode pallas parity tests silently skip.
+try:
+    import jax.experimental.pallas  # noqa: F401
+except Exception:
+    pass
+
 from kube_batch_tpu.utils.backend import force_cpu_devices
 
 if not force_cpu_devices(8):
