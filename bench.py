@@ -51,10 +51,15 @@ def _ensure_live_backend():
         # backend resolution (env alone does not stop it from dialing).
         force_cpu_devices(1)
         return
-    if probe_default_backend(timeout=120, attempts=4, backoff=30) > 0:
+    # Cumulative probe budget ~4.5 min: a wedged tunnel hangs each probe
+    # to its full timeout, and the large-config CPU fallback still needs
+    # ~3 min of runway inside the driver's own deadline.
+    if probe_default_backend(
+        timeout=120, attempts=4, backoff=30, total_budget=270
+    ) > 0:
         return
     print(
-        "bench: accelerator backend unavailable after 4 probes; "
+        "bench: accelerator backend unavailable within the probe budget; "
         "falling back to CPU",
         file=sys.stderr,
     )

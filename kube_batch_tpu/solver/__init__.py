@@ -47,6 +47,7 @@ __all__ = [
     "combine_masks",
     "combine_score_rows",
     "default_mesh",
+    "init_distributed",
     "dynamic_scores",
     "less_equal",
     "make_inputs",
@@ -58,7 +59,6 @@ __all__ = [
     "solve_auto",
     "solve_full_jit",
     "solve_jit",
-    "init_distributed",
     "solve_sharded",
     "solve_staged",
     "solve_staged_jit",

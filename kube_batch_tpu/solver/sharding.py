@@ -66,8 +66,7 @@ def init_distributed(coordinator_address=None, num_processes=None,
         return False
     # Idempotent: a retry path or second defensive join must not crash
     # (jax.distributed.initialize raises if called twice).
-    state = getattr(jax._src.distributed, "global_state", None)
-    if state is not None and getattr(state, "client", None) is not None:
+    if jax.distributed.is_initialized():
         return True
     if num_processes is None:
         env_n = os.environ.get("JAX_NUM_PROCESSES", "")

