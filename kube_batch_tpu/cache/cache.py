@@ -99,22 +99,21 @@ class DefaultVolumeBinder(VolumeBinder):
         self.bind_timeout = bind_timeout
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
-        # Clusters without a claim store (e.g. the real-cluster adapter,
-        # where the k8s PV controller owns binding) treat volumes as
-        # instantly assumable, like the pre-lifecycle behavior.
-        assume = getattr(self.cluster, "assume_pod_volumes", None)
-        if assume is None or not task.pod.spec.volume_claims:
+        if self.cluster is None or not task.pod.spec.volume_claims:
             task.volume_ready = True
             return
-        task.volume_ready = assume(task.pod, hostname)
+        # ClusterAPI's default treats volumes as instantly assumable;
+        # InProcessCluster implements the real assume lifecycle.
+        task.volume_ready = self.cluster.assume_pod_volumes(
+            task.pod, hostname
+        )
 
     def bind_volumes(self, task: TaskInfo) -> None:
-        if task.volume_ready:
+        if task.volume_ready or self.cluster is None:
             return  # cache.go:214-217: ready volumes are not re-bound
-        wait = getattr(self.cluster, "wait_pod_volumes_bound", None)
-        if wait is None:
-            return
-        if not wait(task.pod, self.bind_timeout):
+        if not self.cluster.wait_pod_volumes_bound(
+            task.pod, self.bind_timeout
+        ):
             raise TimeoutError(
                 f"volumes of {task.namespace}/{task.name} not bound "
                 f"within {self.bind_timeout}s"
@@ -124,9 +123,8 @@ class DefaultVolumeBinder(VolumeBinder):
     def release_volumes(self, task: TaskInfo) -> None:
         """Drop the task's claim assumptions after a failed bind so the
         next cycle can place it (or a competitor) elsewhere."""
-        release = getattr(self.cluster, "release_pod_volumes", None)
-        if release is not None:
-            release(task.pod)
+        if self.cluster is not None:
+            self.cluster.release_pod_volumes(task.pod)
 
 
 class SchedulerCache(Cache, EventHandlersMixin):
@@ -386,14 +384,12 @@ class SchedulerCache(Cache, EventHandlersMixin):
                         f"Successfully assigned {pod.namespace}/{pod.name} to {hostname}",
                     )
             except Exception:
-                release = getattr(self.volume_binder, "release_volumes", None)
-                if release is not None:
-                    try:
-                        release(task_snapshot)
-                    except Exception:
-                        logger.exception(
-                            "failed to release volumes of %s", task.uid
-                        )
+                try:
+                    self.volume_binder.release_volumes(task_snapshot)
+                except Exception:
+                    logger.exception(
+                        "failed to release volumes of %s", task.uid
+                    )
                 self._resync_task(task_snapshot)
 
         if self.binder is not None:

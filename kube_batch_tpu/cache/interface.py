@@ -49,6 +49,12 @@ class VolumeBinder(ABC):
     @abstractmethod
     def bind_volumes(self, task: "TaskInfo") -> None: ...
 
+    def release_volumes(self, task: "TaskInfo") -> None:
+        """Undo claim assumptions after a failed bind (default no-op;
+        extension beyond the reference interface, needed because a
+        timed-out bind must return its claims)."""
+        return None
+
 
 class Cache(ABC):
     """reference interface.go:26-55"""

@@ -95,6 +95,19 @@ def _toleration(t: dict) -> Toleration:
     )
 
 
+def _match_expressions(exprs) -> List[dict]:
+    """k8s matchExpressions -> the internal expression-dict form (shared
+    by node affinity terms, preferences, and pod-affinity selectors)."""
+    return [
+        {
+            "key": e.get("key"),
+            "operator": e.get("operator", "In"),
+            "values": list(e.get("values") or []),
+        }
+        for e in exprs or []
+    ]
+
+
 def _affinity(a: dict) -> Affinity:
     node_req = None
     node_pref = None
@@ -116,14 +129,9 @@ def _affinity(a: dict) -> Affinity:
                     "nodeSelectorTerms.matchFields is not supported; "
                     "use matchExpressions"
                 )
-            node_req.append([
-                {
-                    "key": e.get("key"),
-                    "operator": e.get("operator", "In"),
-                    "values": list(e.get("values") or []),
-                }
-                for e in t.get("matchExpressions", []) or []
-            ])
+            node_req.append(
+                _match_expressions(t.get("matchExpressions"))
+            )
     preferred = node_aff.get(
         "preferredDuringSchedulingIgnoredDuringExecution"
     ) or []
@@ -131,16 +139,9 @@ def _affinity(a: dict) -> Affinity:
         node_pref = [
             {
                 "weight": p.get("weight", 1),
-                "expressions": [
-                    {
-                        "key": e.get("key"),
-                        "operator": e.get("operator", "In"),
-                        "values": list(e.get("values") or []),
-                    }
-                    for e in (p.get("preference", {}) or {}).get(
-                        "matchExpressions", []
-                    ) or []
-                ],
+                "expressions": _match_expressions(
+                    (p.get("preference", {}) or {}).get("matchExpressions")
+                ),
             }
             for p in preferred
         ]
@@ -171,14 +172,7 @@ def _affinity(a: dict) -> Affinity:
             }
             exprs = sel.get("matchExpressions") or []
             if exprs:
-                parsed["match_expressions"] = [
-                    {
-                        "key": e.get("key"),
-                        "operator": e.get("operator", "In"),
-                        "values": list(e.get("values") or []),
-                    }
-                    for e in exprs
-                ]
+                parsed["match_expressions"] = _match_expressions(exprs)
             out.append(parsed)
         return out or None
 

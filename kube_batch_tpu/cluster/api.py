@@ -48,6 +48,21 @@ class ClusterAPI:
     # single-host file lock.
     supports_lease_election = False
 
+    # -- volume claims (optional capability) --------------------------------
+    # Default: no claim store — volumes are instantly assumable and never
+    # block binds (the real-cluster adapter inherits these; the k8s PV
+    # controller owns binding there). InProcessCluster overrides with a
+    # real assume/bind lifecycle.
+
+    def assume_pod_volumes(self, pod: Pod, hostname: str) -> bool:
+        return True  # all claims "already bound"
+
+    def wait_pod_volumes_bound(self, pod: Pod, timeout: float) -> bool:
+        return True
+
+    def release_pod_volumes(self, pod: Pod) -> None:
+        return None
+
     # -- reads / watches ----------------------------------------------------
 
     def list_objects(self, kind: str) -> List[object]:

@@ -235,34 +235,49 @@ class KubeCluster(ClusterAPI):
 
     # -- HTTP ---------------------------------------------------------------
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None,
-                 content_type: str = "application/json", timeout: float = 30):
-        url = self.config.server + path
+    def _make_request(self, path: str, method: str = "GET",
+                      body: Optional[dict] = None,
+                      content_type: str = "application/json"):
+        """An authed urllib Request for ``path`` (shared by the JSON
+        round trips and the streaming watch)."""
         data = json.dumps(body).encode() if body is not None else None
-        req = urlrequest.Request(url, data=data, method=method)
+        req = urlrequest.Request(
+            self.config.server + path, data=data, method=method
+        )
         req.add_header("Accept", "application/json")
         if data is not None:
             req.add_header("Content-Type", content_type)
         if self.config.token:
             req.add_header("Authorization", f"Bearer {self.config.token}")
+        return req
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 content_type: str = "application/json", timeout: float = 30):
+        req = self._make_request(path, method, body, content_type)
         resp = urlrequest.urlopen(
             req, timeout=timeout, context=self.config.ssl_context
         )
         payload = resp.read()
         return json.loads(payload) if payload else {}
 
+    def _list_raw(self, kind: str):
+        """LIST a kind; returns (resourceVersion, [item docs]) with each
+        item's apiVersion inherited from the list envelope (list items
+        omit per-item apiVersion/kind)."""
+        path, _ = RESOURCES[kind]
+        result = self._request("GET", path)
+        rv = (result.get("metadata", {}) or {}).get("resourceVersion", "")
+        items = result.get("items", []) or []
+        for item in items:
+            item.setdefault("apiVersion", result.get("apiVersion", "v1"))
+        return rv, items
+
     # -- reads / watches ----------------------------------------------------
 
     def list_objects(self, kind: str) -> List[object]:
-        path, _ = RESOURCES[kind]
-        result = self._request("GET", path)
+        _, items = self._list_raw(kind)
         out = []
-        for item in result.get("items", []) or []:
-            # List items omit per-item apiVersion/kind; inherit the
-            # list's group/version (kind is filled by _to_domain).
-            item.setdefault(
-                "apiVersion", result.get("apiVersion", "v1")
-            )
+        for item in items:
             try:
                 domain = _to_domain(kind, item)
             except Exception:
@@ -322,11 +337,8 @@ class KubeCluster(ClusterAPI):
         a watch gap are not replayed as DELETEs — the cache's resync path
         reconciles those when their next bind/evict fails (the same
         eventual-consistency story the 1 Hz re-snapshot loop provides)."""
-        path, _ = RESOURCES[kind]
-        result = self._request("GET", path)
-        rv = (result.get("metadata", {}) or {}).get("resourceVersion", "")
-        for item in result.get("items", []) or []:
-            item.setdefault("apiVersion", result.get("apiVersion", "v1"))
+        rv, items = self._list_raw(kind)
+        for item in items:
             self._fanout(kind, ADDED, item)
         return rv
 
@@ -352,13 +364,7 @@ class KubeCluster(ClusterAPI):
             qs = "?watch=true&allowWatchBookmarks=true"
             if rv:
                 qs += f"&resourceVersion={rv}"
-            url = self.config.server + path + qs
-            req = urlrequest.Request(url)
-            req.add_header("Accept", "application/json")
-            if self.config.token:
-                req.add_header(
-                    "Authorization", f"Bearer {self.config.token}"
-                )
+            req = self._make_request(path + qs)
             try:
                 resp = urlrequest.urlopen(
                     req,

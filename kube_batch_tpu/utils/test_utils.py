@@ -154,3 +154,6 @@ class FakeVolumeBinder:
 
     def bind_volumes(self, task) -> None:
         return None
+
+    def release_volumes(self, task) -> None:
+        return None

@@ -138,11 +138,9 @@ class FakeKube:
                 with fake.lock:
                     items = list(fake.objects[kind].values())
                     rv = str(fake.rv)
-                api_version = "v1" if path.startswith("/api/v1") else \
-                    path.split("/apis/", 1)[1].rsplit("/", 1)[0].replace(
-                        "/", "/", 1
-                    )
-                if not path.startswith("/api/v1"):
+                if path.startswith("/api/v1"):
+                    api_version = "v1"
+                else:
                     parts = path.split("/")
                     api_version = f"{parts[2]}/{parts[3]}"
                 self._json(200, {
