@@ -405,3 +405,105 @@ class TestChurnSoak:
                 break
             time.sleep(0.05)
         assert consistent, (used, per_node)
+
+
+class TestRestartRecovery:
+    def test_new_scheduler_resumes_from_cluster_state(self):
+        """Checkpoint/resume story (SURVEY.md §5): all durable state lives
+        in the cluster, so a replacement scheduler process — fresh cache,
+        fresh session — picks up half-scheduled work without double
+        accounting: already-Running pods stay put, the rest get placed."""
+        import threading
+        import time
+
+        from kube_batch_tpu.api import PodPhase, build_resource_list
+        from kube_batch_tpu.cache import SchedulerCache
+        from kube_batch_tpu.cluster import InProcessCluster
+        from kube_batch_tpu.scheduler import Scheduler
+        from kube_batch_tpu.utils.test_utils import (
+            build_node, build_pod, build_pod_group, build_queue,
+        )
+
+        cluster = InProcessCluster(simulate_kubelet=True)
+        cluster.create("Queue", build_queue("default"))
+        for j in range(2):
+            cluster.create("Node", build_node(
+                f"n{j}", build_resource_list(cpu="8", memory="16Gi", pods=40)
+            ))
+        cluster.create("PodGroup", build_pod_group(
+            "wave1", namespace="ns", min_member=3, queue="default"
+        ))
+        for i in range(3):
+            cluster.create("Pod", build_pod(
+                "ns", f"w1-p{i}", "", PodPhase.PENDING,
+                build_resource_list(cpu="1", memory="1Gi"),
+                group_name="wave1",
+            ))
+
+        def run_until(sched, cond, timeout=15):
+            stop = threading.Event()
+            t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+            t.start()
+            deadline = time.time() + timeout
+            ok = False
+            while time.time() < deadline:
+                if cond():
+                    ok = True
+                    break
+                time.sleep(0.05)
+            stop.set()
+            t.join(timeout=5)
+            return ok
+
+        def all_running():
+            pods = cluster.list_objects("Pod")
+            return pods and all(
+                p.status.phase == PodPhase.RUNNING for p in pods
+            )
+
+        # First scheduler instance places wave1, then "crashes" (stops).
+        cache1 = SchedulerCache(cluster=cluster)
+        assert run_until(Scheduler(cache1, schedule_period=0.05),
+                         all_running)
+        placed_before = {
+            p.metadata.name: p.spec.node_name
+            for p in cluster.list_objects("Pod")
+        }
+
+        # New work arrives while no scheduler runs.
+        cluster.create("PodGroup", build_pod_group(
+            "wave2", namespace="ns", min_member=2, queue="default"
+        ))
+        for i in range(2):
+            cluster.create("Pod", build_pod(
+                "ns", f"w2-p{i}", "", PodPhase.PENDING,
+                build_resource_list(cpu="1", memory="1Gi"),
+                group_name="wave2",
+            ))
+
+        # Replacement process: fresh cache + scheduler over the same
+        # cluster. It must re-ingest wave1 as Running (no rebind) and
+        # place wave2.
+        cache2 = SchedulerCache(cluster=cluster)
+        assert run_until(Scheduler(cache2, schedule_period=0.05),
+                         all_running)
+        after = {
+            p.metadata.name: p.spec.node_name
+            for p in cluster.list_objects("Pod")
+        }
+        for name, node in placed_before.items():
+            assert after[name] == node  # wave1 untouched
+        assert all(after[f"w2-p{i}"] for i in range(2))
+        # No double accounting in the replacement's cache: used cpu on
+        # each node equals the cluster's actual assignments.
+        cache2.wait_for_side_effects()
+        per_node = {}
+        for p in cluster.list_objects("Pod"):
+            per_node[p.spec.node_name] = (
+                per_node.get(p.spec.node_name, 0.0) + 1000.0
+            )
+        with cache2.mutex:
+            for name, node in cache2.nodes.items():
+                assert abs(
+                    node.used.milli_cpu - per_node.get(name, 0.0)
+                ) < 1e-6, (name, node.used.milli_cpu, per_node)
