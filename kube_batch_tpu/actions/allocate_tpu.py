@@ -122,7 +122,17 @@ class AllocateTpuAction(Action):
         # (kernel feas mask), its queue must not be overused
         # (allocate.go:94-95), and among eligible nodes the best-scored one
         # wins, mirroring PrioritizeNodes → SelectBestNode.
-        for i, task in enumerate(ctx.tasks):
+        #
+        # Only nodes that actually hold Releasing capacity can take a
+        # pipeline; in the common no-eviction cycle that set is empty and
+        # the whole O(leftovers x nodes) pass is skipped.
+        releasing_nodes = [
+            (j, ssn.nodes[node.name])
+            for j, node in enumerate(ctx.nodes)
+            if not ssn.nodes[node.name].releasing.is_empty()
+        ]
+        leftovers = enumerate(ctx.tasks) if releasing_nodes else ()
+        for i, task in leftovers:
             if int(assigned[i]) >= 0:
                 continue
             job = ssn.jobs.get(task.job)
@@ -133,10 +143,10 @@ class AllocateTpuAction(Action):
                 continue
             feas_row = ctx.mask.row(i)
             candidates = [
-                ssn.nodes[node.name]
-                for j, node in enumerate(ctx.nodes)
+                node
+                for j, node in releasing_nodes
                 if feas_row[j]
-                and task.init_resreq.less_equal(ssn.nodes[node.name].releasing)
+                and task.init_resreq.less_equal(node.releasing)
             ]
             if not candidates:
                 continue
