@@ -132,6 +132,23 @@ class TestPriority:
             assert len(ctx.running_pods("lo")) == 0
 
 
+    def test_task_priority_within_job(self):
+        """'Task Priority' (job.go:289): within one job, higher-priority
+        tasks are allocated first when capacity cannot hold all of them."""
+        with Context(nodes=1, node_cpu="2", node_mem="8Gi") as ctx:
+            pods = ctx.create_job(JobSpec(
+                name="mix", replicas=4, min_member=1
+            ))
+            for i, p in enumerate(pods):
+                p.spec.priority = 1000 if i >= 2 else 1
+            ctx.submit(pods)
+            assert ctx.wait_tasks_ready("mix", 2)
+            running = {
+                p.metadata.name for p in ctx.running_pods("mix")
+            }
+            assert running == {"mix-2", "mix-3"}, running
+
+
 class TestProportion:
     def test_weighted_queue_share(self):
         """'Proportion' (job.go:418): two queues split a full cluster by
