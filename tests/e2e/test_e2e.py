@@ -135,13 +135,28 @@ class TestPriority:
     def test_task_priority_within_job(self):
         """'Task Priority' (job.go:289): within one job, higher-priority
         tasks are allocated first when capacity cannot hold all of them."""
+        from kube_batch_tpu.utils.test_utils import build_pod, build_pod_group
+
         with Context(nodes=1, node_cpu="2", node_mem="8Gi") as ctx:
-            pods = ctx.create_job(JobSpec(
-                name="mix", replicas=4, min_member=1
-            ))
+            # Pods first, PodGroup LAST: the job has no scheduling spec
+            # until the group exists, so the live scheduler cannot bind a
+            # mid-submit prefix — when it finally sees the job, all four
+            # tasks are present and only the priority order decides.
+            # High priority deliberately on the LAST-created pods so the
+            # outcome differs from FIFO/creation order.
+            pods = [
+                build_pod(
+                    "test", f"mix-{i}", "", PodPhase.PENDING, dict(ONE_CPU),
+                    group_name="mix",
+                )
+                for i in range(4)
+            ]
             for i, p in enumerate(pods):
                 p.spec.priority = 1000 if i >= 2 else 1
             ctx.submit(pods)
+            ctx.cluster.create_pod_group(build_pod_group(
+                "mix", namespace="test", min_member=1
+            ))
             assert ctx.wait_tasks_ready("mix", 2)
             running = {
                 p.metadata.name for p in ctx.running_pods("mix")
