@@ -157,7 +157,10 @@ def solve_native(inputs) -> Tuple[np.ndarray, int]:
     (padded) node table, matching ``SolveResult.assigned``'s contract so
     ``allocate_tpu`` can apply either interchangeably."""
     lib = _load()
-    s = inputs.unpack()
+    # PackedInputs (the transfer bundle) or bare SolverInputs — same
+    # dispatch as solve_auto's isinstance check, via hasattr so this
+    # module stays jax-free.
+    s = inputs.unpack() if hasattr(inputs, "unpack") else inputs
 
     def f32(a):
         return np.ascontiguousarray(np.asarray(a), np.float32)

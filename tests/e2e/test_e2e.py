@@ -147,12 +147,10 @@ class TestPriority:
             pods = [
                 build_pod(
                     "test", f"mix-{i}", "", PodPhase.PENDING, dict(ONE_CPU),
-                    group_name="mix",
+                    group_name="mix", priority=1000 if i >= 2 else 1,
                 )
                 for i in range(4)
             ]
-            for i, p in enumerate(pods):
-                p.spec.priority = 1000 if i >= 2 else 1
             ctx.submit(pods)
             ctx.cluster.create_pod_group(build_pod_group(
                 "mix", namespace="test", min_member=1

@@ -1,0 +1,105 @@
+"""Property-based solver invariants (hypothesis).
+
+The parity suites check specific scenarios; these drive RANDOM instances
+through one jitted shape (so each example reuses the compiled program)
+and assert the invariants every schedule must satisfy regardless of
+scores or conflicts:
+
+- assignments land only on mask-feasible nodes that fit,
+- per-node usage never exceeds initial idle (+epsilon),
+- pod-count caps (node_max_tasks) are respected,
+- invalid (padded) tasks are never assigned,
+- the native CPU fallback satisfies the same invariants on the same
+  instance.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from kube_batch_tpu.solver import make_inputs, solve_jit
+
+try:
+    from kube_batch_tpu.native import native_available, solve_native
+    HAVE_NATIVE = native_available()
+except Exception:  # pragma: no cover - no toolchain
+    HAVE_NATIVE = False
+
+T, N, R = 64, 16, 2
+EPS = 10.0
+
+
+def build(seed):
+    rng = np.random.RandomState(seed)
+    task_req = np.c_[
+        rng.choice([250, 500, 1000, 2000], T),
+        rng.choice([256, 512, 2048], T),
+    ].astype(np.float32)
+    feas = rng.rand(T, N) > rng.uniform(0.0, 0.6)
+    idle = np.c_[
+        rng.choice([1000, 4000, 8000], N),
+        rng.choice([2048, 8192], N),
+    ].astype(np.float32)
+    valid = rng.rand(T) > 0.1
+    queue = rng.randint(0, 2, T).astype(np.int32)
+    max_tasks = rng.choice([0, 3], N).astype(np.int32)
+    deserved = np.asarray(
+        [[rng.choice([3000.0, np.inf]), np.inf], [np.inf, np.inf]],
+        np.float32,
+    )
+    inputs = make_inputs(
+        feas=jnp.asarray(feas),
+        task_req=jnp.asarray(task_req),
+        task_fit=jnp.asarray(task_req),
+        task_rank=jnp.arange(T, dtype=jnp.int32),
+        task_job=jnp.asarray(rng.randint(0, 8, T), jnp.int32),
+        task_queue=jnp.asarray(queue),
+        task_valid=jnp.asarray(valid),
+        node_idle=jnp.asarray(idle),
+        node_releasing=jnp.zeros((N, R), jnp.float32),
+        node_cap=jnp.asarray(idle),
+        node_task_count=jnp.zeros(N, jnp.int32),
+        node_max_tasks=jnp.asarray(max_tasks),
+        queue_deserved=jnp.asarray(deserved),
+        queue_allocated=jnp.zeros((2, R), jnp.float32),
+        eps=jnp.full((R,), EPS, jnp.float32),
+        lr_weight=jnp.asarray(1.0, jnp.float32),
+        br_weight=jnp.asarray(1.0, jnp.float32),
+    )
+    return inputs, task_req, feas, idle, valid, max_tasks
+
+
+def check_invariants(assigned, task_req, feas, idle, valid, max_tasks,
+                     label):
+    used = np.zeros_like(idle)
+    counts = np.zeros(N, np.int64)
+    for t in range(T):
+        j = int(assigned[t])
+        if j < 0:
+            continue
+        assert valid[t], f"{label}: invalid task {t} assigned"
+        assert j < N, f"{label}: task {t} assigned past node table"
+        assert feas[t, j], f"{label}: task {t} on masked node {j}"
+        used[j] += task_req[t]
+        counts[j] += 1
+    assert np.all(used - idle < EPS + 1e-3), (
+        f"{label}: node over-committed", used, idle
+    )
+    capped = max_tasks > 0
+    assert np.all(counts[capped] <= max_tasks[capped]), (
+        f"{label}: pod-count cap exceeded", counts, max_tasks
+    )
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.integers(min_value=0, max_value=10_000))
+def test_solver_invariants_random_instances(seed):
+    inputs, task_req, feas, idle, valid, max_tasks = build(seed)
+    assigned = np.asarray(solve_jit(inputs).assigned)
+    check_invariants(assigned, task_req, feas, idle, valid, max_tasks, "jax")
+    if HAVE_NATIVE:
+        n_assigned, _ = solve_native(inputs)
+        check_invariants(
+            n_assigned, task_req, feas, idle, valid, max_tasks, "native"
+        )
