@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 
 import numpy as np
 
@@ -33,6 +34,19 @@ from ..solver import solve_sharded, tensorize
 from ..utils.scheduler_helper import prioritize_nodes, select_best_node
 
 logger = logging.getLogger(__name__)
+
+# Phase timings of the most recent execute(), read by the bench harness
+# (bench.py:bench_cycle). The same phases feed /metrics via
+# metrics.update_solver_phase — BASELINE.md's <100 ms target is for the
+# WHOLE cycle, not the kernel, so the budget split must be observable.
+# Single-threaded by construction: one scheduler loop mutates it, bench
+# reads it between cycles.
+last_stats: dict = {}
+
+
+def _record_phase(phase: str, ms: float) -> None:
+    last_stats[phase + "_ms"] = ms
+    metrics.update_solver_phase(phase, ms / 1e3)
 
 
 def _use_native_solver() -> bool:
@@ -70,10 +84,14 @@ class AllocateTpuAction(Action):
         return "allocate_tpu"
 
     def execute(self, ssn) -> None:
+        t0 = time.perf_counter()
         inputs, ctx = tensorize(ssn)
+        last_stats.clear()
+        _record_phase("tensorize", (time.perf_counter() - t0) * 1e3)
         if inputs is None:
             return
 
+        t0 = time.perf_counter()
         if _use_native_solver():
             from ..native import solve_native
 
@@ -91,7 +109,10 @@ class AllocateTpuAction(Action):
 
             backend = f"jax-{jax.devices()[0].platform}"
         metrics.update_solver_cycle(rounds, backend)
+        _record_phase("solve", (time.perf_counter() - t0) * 1e3)
+        last_stats.update(backend=backend, rounds=rounds)
 
+        t0 = time.perf_counter()
         placed = 0
         # ctx.tasks is already in global priority-rank order.
         for i in range(len(ctx.tasks)):
@@ -116,6 +137,10 @@ class AllocateTpuAction(Action):
                     "Failed to bind Task %s on %s", task.uid, node_name
                 )
 
+        _record_phase("apply", (time.perf_counter() - t0) * 1e3)
+        last_stats["placed"] = placed
+
+        t0 = time.perf_counter()
         # Epilogue: pipeline unassigned tasks onto Releasing resources
         # (allocate.go:168-181), a host-side pass over the leftovers.
         # Same gates as greedy: the task must pass predicates on the node
@@ -164,6 +189,7 @@ class AllocateTpuAction(Action):
                     "Failed to pipeline Task %s on %s", task.uid, best.name
                 )
 
+        _record_phase("epilogue", (time.perf_counter() - t0) * 1e3)
         logger.debug(
             "allocate_tpu placed %d/%d tasks in %d rounds",
             placed, len(ctx.tasks), rounds,

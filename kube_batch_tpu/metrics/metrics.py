@@ -176,6 +176,13 @@ solver_backend_cycles = REGISTRY.register(
     ),
     ("backend",),
 )
+solver_phase_latency = REGISTRY.register(
+    Histogram(
+        "solver_phase_latency_seconds",
+        "allocate_tpu per-phase latency (tensorize/solve/apply/epilogue)",
+    ),
+    ("phase",),
+)
 
 
 # Update helpers (reference metrics.go:122-170).
@@ -225,3 +232,10 @@ def update_solver_cycle(rounds: int, backend: str) -> None:
     solved it ("jax-<platform>" or "native")."""
     solver_iterations.set(rounds)
     solver_backend_cycles.inc((backend,))
+
+
+def update_solver_phase(phase: str, seconds: float) -> None:
+    """Per-phase allocate_tpu latency (the cycle budget split the
+    reference has no analog for: host tensorize vs device solve vs host
+    apply)."""
+    solver_phase_latency.observe(seconds, (phase,))
