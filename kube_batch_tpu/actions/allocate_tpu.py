@@ -113,29 +113,60 @@ class AllocateTpuAction(Action):
         last_stats.update(backend=backend, rounds=rounds)
 
         t0 = time.perf_counter()
-        placed = 0
-        # ctx.tasks is already in global priority-rank order.
-        for i in range(len(ctx.tasks)):
-            j = int(assigned[i])
-            if j < 0:
-                continue
-            task, node_name = ctx.tasks[i], ctx.nodes[j].name
-            node = ssn.nodes[node_name]
-            if not task.init_resreq.less_equal(node.idle):
-                # Kernel accounting and session drifted (should not happen);
-                # skip rather than corrupt node bookkeeping.
-                logger.warning(
-                    "solver assignment no longer fits: task %s on %s",
-                    task.uid, node_name,
-                )
-                continue
-            try:
-                ssn.allocate(task, node_name)
-                placed += 1
-            except Exception:
-                logger.exception(
-                    "Failed to bind Task %s on %s", task.uid, node_name
-                )
+        # ctx.tasks is already in global priority-rank order. The
+        # sequential guard ("does this task still fit the node, given
+        # everything applied before it?") is evaluated for ALL assignments
+        # at once: per-node cumulative sums of init_resreq in priority
+        # order vs node idle, with less_equal's epsilon tolerance
+        # (resource_info.go:253-277: l <= r iff l < r + eps per dim).
+        # When everything fits — the invariant the kernel's capacity
+        # accounting guarantees — the whole set is applied via the batched
+        # session path; on drift (should not happen) fall back to the
+        # per-task guarded loop.
+        T = len(ctx.tasks)
+        a = np.asarray(assigned[:T])
+        sel = np.nonzero(a >= 0)[0]
+        all_fit = True
+        if sel.size:
+            nodes_sel = a[sel]
+            order = np.argsort(nodes_sel, kind="stable")
+            rows = ctx.task_fit_host[sel][order]
+            cum = np.cumsum(rows, axis=0)
+            seg_starts = np.nonzero(
+                np.diff(nodes_sel[order], prepend=-1)
+            )[0]
+            base = np.zeros_like(cum)
+            base[seg_starts[1:]] = cum[seg_starts[1:] - 1]
+            cum -= np.maximum.accumulate(base, axis=0)
+            idle = ctx.node_idle_host[nodes_sel[order]]
+            eps = ctx.layout.eps().astype(np.float64)
+            all_fit = bool((cum < idle + eps).all())
+        if all_fit:
+            placed = ssn.allocate_batch(
+                [(ctx.tasks[i], ctx.nodes[a[i]].name) for i in sel]
+            )
+        else:
+            logger.warning(
+                "solver assignment drifted from session accounting; "
+                "applying with the per-task guard"
+            )
+            placed = 0
+            for i in sel:
+                task, node_name = ctx.tasks[i], ctx.nodes[a[i]].name
+                node = ssn.nodes[node_name]
+                if not task.init_resreq.less_equal(node.idle):
+                    logger.warning(
+                        "solver assignment no longer fits: task %s on %s",
+                        task.uid, node_name,
+                    )
+                    continue
+                try:
+                    ssn.allocate(task, node_name)
+                    placed += 1
+                except Exception:
+                    logger.exception(
+                        "Failed to bind Task %s on %s", task.uid, node_name
+                    )
 
         _record_phase("apply", (time.perf_counter() - t0) * 1e3)
         last_stats["placed"] = placed

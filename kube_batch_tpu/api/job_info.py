@@ -184,12 +184,36 @@ class JobInfo:
         self._delete_task_index(task)
 
     def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
-        """Delete + re-add under the new status index
-        (reference job_info.go:245-258)."""
+        """Move a task to a new status index (reference job_info.go:245-258
+        does delete+re-add; here the cancelling total_request sub/add is
+        skipped and ``allocated`` is adjusted only when the allocated-ness
+        of the status actually changes — same end state, and this runs
+        3x per placement on the hot apply path)."""
         validate_status_update(task.status, status)
-        self.delete_task_info(task)
+        stored = self.tasks.get(task.uid)
+        if stored is None:
+            raise KeyError(
+                f"failed to find task <{task.namespace}/{task.name}> "
+                f"in job <{self.namespace}/{self.name}>"
+            )
+        now = allocated_status(status)
+        if stored is not task:
+            # A clone was passed (its status/resreq may have drifted from
+            # the stored task): keep the full delete+re-add accounting so
+            # the stored entry leaves its true index bucket and the
+            # aggregates track the replacement's resreq.
+            self.delete_task_info(stored)
+            task.status = status
+            self.add_task_info(task)
+            return
+        self._delete_task_index(stored)
+        was = allocated_status(stored.status)
+        if was and not now:
+            self.allocated.sub(task.resreq)
+        elif now and not was:
+            self.allocated.add(task.resreq)
         task.status = status
-        self.add_task_info(task)
+        self._add_task_index(task)
 
     def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
         """Clones of all tasks in the given statuses (reference :210-222)."""

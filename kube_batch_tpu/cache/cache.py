@@ -348,52 +348,110 @@ class SchedulerCache(Cache, EventHandlersMixin):
             raise KeyError(f"failed to find task <{ti.namespace}/{ti.name}>")
         return job, task
 
+    def _bind_bookkeeping(self, task_info: TaskInfo, hostname: str):
+        """Under-mutex half of bind: validate, move to Binding, account on
+        the node. Returns (pod, hostname, task clone) for the side effect.
+        Caller must hold self.mutex."""
+        job, task = self._find_job_and_task(task_info)
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(
+                f"failed to bind Task {task.uid} to host {hostname}: "
+                f"host does not exist"
+            )
+        if task.status not in (TaskStatus.PENDING, TaskStatus.ALLOCATED):
+            raise ValueError(
+                f"failed to bind Task {task.uid}: status is "
+                f"{task.status.name}, expected Pending/Allocated"
+            )
+        job.update_task_status(task, TaskStatus.BINDING)
+        task.node_name = hostname
+        node.add_task(task)
+        return task.pod, hostname, task.clone()
+
+    def _bind_side_effect(self, pod, hostname, task_snapshot) -> None:
+        """Async half of bind. The volume bind wait (up to the reference's
+        30s, cache.go:260-268) runs HERE on the side-effect pool, not in
+        the scheduling loop — one slow volume must not stall every other
+        job's cycle. A timeout/failure releases the claim assumptions and
+        resyncs the task without binding the pod."""
+        try:
+            self.volume_binder.bind_volumes(task_snapshot)
+            self.binder.bind(pod, hostname)
+            if self.cluster is not None:
+                self.cluster.record_event(
+                    pod, "Normal", "Scheduled",
+                    f"Successfully assigned {pod.namespace}/{pod.name} "
+                    f"to {hostname}",
+                )
+        except Exception:
+            try:
+                self.volume_binder.release_volumes(task_snapshot)
+            except Exception:
+                logger.exception(
+                    "failed to release volumes of %s", task_snapshot.uid
+                )
+            self._resync_task(task_snapshot)
+
     def bind(self, task_info: TaskInfo, hostname: str) -> None:
         """reference cache.go:480-522"""
         with self.mutex:
-            job, task = self._find_job_and_task(task_info)
-            node = self.nodes.get(hostname)
-            if node is None:
-                raise KeyError(
-                    f"failed to bind Task {task.uid} to host {hostname}: "
-                    f"host does not exist"
-                )
-            if task.status not in (TaskStatus.PENDING, TaskStatus.ALLOCATED):
-                raise ValueError(
-                    f"failed to bind Task {task.uid}: status is "
-                    f"{task.status.name}, expected Pending/Allocated"
-                )
-            job.update_task_status(task, TaskStatus.BINDING)
-            task.node_name = hostname
-            node.add_task(task)
-            pod = task.pod
-            task_snapshot = task.clone()
-
-        def _do_bind():
-            try:
-                # The volume bind wait (up to the reference's 30s,
-                # cache.go:260-268) runs HERE on the side-effect pool, not
-                # in the scheduling loop — one slow volume must not stall
-                # every other job's cycle. A timeout releases the claim
-                # assumptions and resyncs the task without binding the pod.
-                self.volume_binder.bind_volumes(task_snapshot)
-                self.binder.bind(pod, hostname)
-                if self.cluster is not None:
-                    self.cluster.record_event(
-                        pod, "Normal", "Scheduled",
-                        f"Successfully assigned {pod.namespace}/{pod.name} to {hostname}",
-                    )
-            except Exception:
-                try:
-                    self.volume_binder.release_volumes(task_snapshot)
-                except Exception:
-                    logger.exception(
-                        "failed to release volumes of %s", task.uid
-                    )
-                self._resync_task(task_snapshot)
+            pod, hostname, task_snapshot = self._bind_bookkeeping(
+                task_info, hostname
+            )
 
         if self.binder is not None:
-            self._submit_side_effect(_do_bind)
+            self._submit_side_effect(
+                lambda: self._bind_side_effect(pod, hostname, task_snapshot)
+            )
+
+    # Batched side-effect jobs are chunked so (a) a 50k-task gang doesn't
+    # monopolize one of the pool's workers for its whole serial run and
+    # (b) all workers share the bind backlog.
+    _BIND_CHUNK = 1024
+
+    def bind_batch(self, task_infos) -> list:
+        """Batched :meth:`bind`: one mutex hold for all bookkeeping and a
+        handful of chunked side-effect jobs instead of one executor
+        submission + lock round trip per task (profile r3: those were ~40%
+        of the apply phase at 10k tasks). Per-task semantics are bind()'s:
+        validation failures are logged and skipped, side-effect failures
+        release volumes and resync that task only. Tasks whose volumes are
+        NOT ready are submitted as individual jobs — their bind may block
+        up to the volume-bind timeout, and a slow volume must not
+        head-of-line-block the rest of the gang. Each task_info must have
+        node_name set. Returns the tasks whose bookkeeping succeeded."""
+        binds = []
+        slow_binds = []  # volume wait possible: isolate per task
+        bound = []
+        with self.mutex:
+            for ti in task_infos:
+                try:
+                    item = self._bind_bookkeeping(ti, ti.node_name)
+                    if item[2].volume_ready:
+                        binds.append(item)
+                    else:
+                        slow_binds.append(item)
+                    bound.append(ti)
+                except Exception:
+                    logger.exception(
+                        "failed to bind task %s/%s", ti.namespace, ti.name
+                    )
+
+        if self.binder is not None:
+            def _do_binds(chunk):
+                for pod, hostname, task_snapshot in chunk:
+                    self._bind_side_effect(pod, hostname, task_snapshot)
+
+            for start in range(0, len(binds), self._BIND_CHUNK):
+                chunk = binds[start:start + self._BIND_CHUNK]
+                self._submit_side_effect(lambda c=chunk: _do_binds(c))
+            for pod, hostname, task_snapshot in slow_binds:
+                self._submit_side_effect(
+                    lambda p=pod, h=hostname, s=task_snapshot:
+                        self._bind_side_effect(p, h, s)
+                )
+        return bound
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         """reference cache.go:421-477"""

@@ -9,11 +9,14 @@ Mirrors reference pkg/scheduler/cache/interface.go:
 
 from __future__ import annotations
 
+import logging
 from abc import ABC, abstractmethod
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:
     from ..api import ClusterInfo, JobInfo, Pod, PodCondition, PodGroup, TaskInfo
+
+logger = logging.getLogger(__name__)
 
 
 class Binder(ABC):
@@ -70,6 +73,22 @@ class Cache(ABC):
 
     @abstractmethod
     def bind(self, task: "TaskInfo", hostname: str) -> None: ...
+
+    def bind_batch(self, task_infos) -> list:
+        """Batched bind (TPU-native extension): one bookkeeping pass + one
+        async side-effect job for a whole gang. Default falls back to
+        per-task bind(); SchedulerCache overrides with the real batch.
+        Each task must carry node_name. Returns tasks accepted."""
+        bound = []
+        for ti in task_infos:
+            try:
+                self.bind(ti, ti.node_name)
+                bound.append(ti)
+            except Exception:  # parity with bind_batch's skip-and-log
+                logger.exception(
+                    "failed to bind task %s/%s", ti.namespace, ti.name
+                )
+        return bound
 
     @abstractmethod
     def evict(self, task: "TaskInfo", reason: str) -> None: ...

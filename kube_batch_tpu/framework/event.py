@@ -17,3 +17,8 @@ class Event:
 class EventHandler:
     allocate_func: Optional[Callable[[Event], None]] = None
     deallocate_func: Optional[Callable[[Event], None]] = None
+    # TPU-native extension: batched forms, called ONCE with the full event
+    # list by Session.allocate_batch. A handler that provides the batch
+    # form must make it equivalent to folding allocate_func over the
+    # events; handlers without one get the per-event fallback.
+    batch_allocate_func: Optional[Callable[[list], None]] = None

@@ -205,6 +205,66 @@ class Session:
             ):
                 self.dispatch(t)
 
+    def allocate_batch(self, pairs) -> int:
+        """Apply a solved assignment set in one pass: the batched
+        equivalent of calling :meth:`allocate` per task, for the
+        allocate_tpu apply phase (VERDICT r2: 50k sequential allocate()
+        calls dominate the cycle).
+
+        ``pairs`` is ``[(task, hostname), ...]`` in global priority order.
+        Semantics preserved vs the sequential loop:
+
+        - per-task volume assumption and node/job bookkeeping, in order;
+        - plugin event handlers observe every allocation (batched form
+          when the handler provides one, per-event otherwise);
+        - gang dispatch: a job whose allocations make it JobReady has ALL
+          its Allocated tasks dispatched (sequentially this happens the
+          moment the gang crosses minAvailable and then after each later
+          allocate — the end state, every Allocated task of a ready job
+          dispatched, is identical);
+        - per-task failures are logged and skipped, not fatal.
+
+        Returns the number of tasks allocated."""
+        events: List[Event] = []
+        jobs_touched: Dict[str, JobInfo] = {}
+        for task, hostname in pairs:
+            job = self.jobs.get(task.job)
+            if job is None:
+                logger.warning("failed to find job %s", task.job)
+                continue
+            node = self.nodes.get(hostname)
+            if node is None:
+                logger.warning("failed to find node %s", hostname)
+                continue
+            try:
+                self.cache.allocate_volumes(task, hostname)
+                job.update_task_status(task, TaskStatus.ALLOCATED)
+                task.node_name = hostname
+                node.add_task(task)
+            except Exception:
+                logger.exception(
+                    "Failed to allocate Task %s on %s", task.uid, hostname
+                )
+                continue
+            events.append(Event(task))
+            jobs_touched[job.uid] = job
+        if not events:
+            return 0
+        for eh in self.event_handlers:
+            if eh.batch_allocate_func is not None:
+                eh.batch_allocate_func(events)
+            elif eh.allocate_func is not None:
+                for ev in events:
+                    eh.allocate_func(ev)
+        for job in jobs_touched.values():
+            if self.job_ready(job):
+                self.dispatch_batch(list(
+                    job.task_status_index.get(
+                        TaskStatus.ALLOCATED, {}
+                    ).values()
+                ))
+        return len(events)
+
     def dispatch(self, task: TaskInfo) -> None:
         """Bind one gang member (reference session.go:294-318)."""
         self.cache.bind_volumes(task)
@@ -217,6 +277,29 @@ class Session:
         metrics.update_task_schedule_duration(
             max(0.0, _time.time() - task.pod.metadata.creation_timestamp)
         )
+
+    def dispatch_batch(self, tasks: List[TaskInfo]) -> None:
+        """Bind a whole ready gang with one cache round trip (one mutex
+        hold, one async side-effect job) instead of per-task dispatch."""
+        ready: List[TaskInfo] = []
+        for task in tasks:
+            try:
+                self.cache.bind_volumes(task)
+            except Exception:
+                logger.exception("Failed to bind volumes of %s", task.uid)
+                continue
+            ready.append(task)
+        bound = self.cache.bind_batch(ready)
+        now = _time.time()
+        for task in bound:
+            job = self.jobs.get(task.job)
+            if job is None:
+                logger.warning("failed to find job %s", task.job)
+                continue
+            job.update_task_status(task, TaskStatus.BINDING)
+            metrics.update_task_schedule_duration(
+                max(0.0, now - task.pod.metadata.creation_timestamp)
+            )
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """Direct eviction (reference session.go:321-358)."""

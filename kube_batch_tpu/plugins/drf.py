@@ -96,8 +96,23 @@ class DrfPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
+        def on_allocate_batch(events):
+            # Fold of on_allocate: one aggregate add + share update per
+            # job instead of per task (the apply-phase hot path).
+            touched: Dict[str, _DrfAttr] = {}
+            for ev in events:
+                attr = self.job_attrs[ev.task.job]
+                attr.allocated.add(ev.task.resreq)
+                touched[ev.task.job] = attr
+            for attr in touched.values():
+                self._update_share(attr)
+
         ssn.add_event_handler(
-            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+            EventHandler(
+                allocate_func=on_allocate,
+                deallocate_func=on_deallocate,
+                batch_allocate_func=on_allocate_batch,
+            )
         )
 
     def on_session_close(self, ssn) -> None:

@@ -162,8 +162,23 @@ class ProportionPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
+        def on_allocate_batch(events):
+            # Fold of on_allocate: aggregate per queue, one share update.
+            touched = {}
+            for ev in events:
+                job = ssn.jobs[ev.task.job]
+                attr = self.queue_attrs[job.queue]
+                attr.allocated.add(ev.task.resreq)
+                touched[job.queue] = attr
+            for attr in touched.values():
+                self._update_share(attr)
+
         ssn.add_event_handler(
-            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+            EventHandler(
+                allocate_func=on_allocate,
+                deallocate_func=on_deallocate,
+                batch_allocate_func=on_allocate_batch,
+            )
         )
 
     def on_session_close(self, ssn) -> None:

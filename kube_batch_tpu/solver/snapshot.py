@@ -93,6 +93,11 @@ class SnapshotContext:
     nodes: List[NodeInfo]
     queues: List[QueueInfo]
     mask: Optional["CombinedMask"] = None  # host-side feasibility rows
+    # Unpadded host copies for the vectorized apply-phase fit guard
+    # (task init_resreq rows [T,R] and node idle [N,R], float64 so
+    # cumulative sums stay exact against the epsilon comparisons).
+    task_fit_host: Optional[np.ndarray] = None
+    node_idle_host: Optional[np.ndarray] = None
 
 
 def _sorted_by(items, less_fn):
@@ -293,9 +298,8 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
     )
     task_job = task_job.astype(np.int32)
 
-    node_idle = _resource_matrix(
-        [n.idle for n in nodes], layout
-    ).astype(np.float32)
+    node_idle64 = _resource_matrix([n.idle for n in nodes], layout)
+    node_idle = node_idle64.astype(np.float32)
     node_releasing = _resource_matrix(
         [n.releasing for n in nodes], layout
     ).astype(np.float32)
@@ -433,5 +437,8 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
             layout.eps(), [lr_w, br_w]
         ]).astype(np.float32)),
     )
-    ctx = SnapshotContext(layout, tasks, nodes, queue_order, mask)
+    ctx = SnapshotContext(
+        layout, tasks, nodes, queue_order, mask,
+        task_fit_host=fit_mat[order], node_idle_host=node_idle64,
+    )
     return inputs, ctx
