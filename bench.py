@@ -266,11 +266,14 @@ def bench_cycle(cfg, seed=0):
     """Full scheduling cycles through the production allocate_tpu action —
     the number BASELINE.md's <100 ms target is really about (the reference
     hot path is the whole runOnce, scheduler.go:88-103, not the inner
-    kernel). Three scenarios:
+    kernel). Four scenarios:
 
     - cold:   first cycle on a fresh full-scale pending burst;
-    - steady: the very next cycle, cluster unchanged (placed pods now
-      Binding, leftovers still pending);
+    - steady: the very next cycle — every placed job/node changed in
+      cold, so the COW snapshot pool re-clones the world (its worst
+      case);
+    - idle:   one more unchanged cycle — nothing dirty, the pool and
+      early-exit tensorize shine (the common 1 Hz case);
     - delta:  a ~1% batch of new gangs arrives, next cycle.
 
     Each cycle reports open/tensorize/solve/apply/epilogue/close phases
@@ -306,6 +309,7 @@ def bench_cycle(cfg, seed=0):
 
     cold = one_cycle()
     steady = one_cycle()
+    idle = one_cycle()
 
     # ~1% new gangs arrive, drawn from the same shape mix as build_cluster.
     rng = np.random.RandomState(seed + 1)
@@ -329,7 +333,7 @@ def bench_cycle(cfg, seed=0):
             ))
     delta = one_cycle()
     cache.shutdown()
-    return {"cold": cold, "steady": steady, "delta": delta}
+    return {"cold": cold, "steady": steady, "idle": idle, "delta": delta}
 
 
 def main():

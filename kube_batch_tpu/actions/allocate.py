@@ -88,8 +88,7 @@ class AllocateAction(Action):
                 task = tasks.pop()
                 # Stale fit data is for tasks that eventually fit
                 # (allocate.go:127-133).
-                if job.nodes_fit_delta:
-                    job.nodes_fit_delta = {}
+                job.clear_fit_deltas()
 
                 fit_nodes = predicate_nodes(task, all_nodes, predicate_fn)
                 if not fit_nodes:
@@ -113,7 +112,7 @@ class AllocateAction(Action):
                     # Record missing resources (allocate.go:168-173).
                     delta = node.idle.clone()
                     delta.fit_delta(task.init_resreq)
-                    job.nodes_fit_delta[node.name] = delta
+                    job.record_fit_delta(node.name, delta)
                     # Pipeline onto releasing resources (allocate.go:175-181).
                     if task.init_resreq.less_equal(node.releasing):
                         try:

@@ -116,13 +116,16 @@ class AllocateTpuAction(Action):
         # ctx.tasks is already in global priority-rank order. The
         # sequential guard ("does this task still fit the node, given
         # everything applied before it?") is evaluated for ALL assignments
-        # at once: per-node cumulative sums of init_resreq in priority
-        # order vs node idle, with less_equal's epsilon tolerance
-        # (resource_info.go:253-277: l <= r iff l < r + eps per dim).
-        # When everything fits — the invariant the kernel's capacity
-        # accounting guarantees — the whole set is applied via the batched
-        # session path; on drift (should not happen) fall back to the
-        # per-task guarded loop.
+        # at once. Sequential semantics being reproduced: each allocation
+        # checks its own init_resreq against idle (allocate_tpu guard /
+        # node_info.go:161-171), while applied allocations shrink idle by
+        # RESREQ (add_task subtracts resreq, not init_resreq). So per
+        # node, in priority order: exclusive-prefix(resreq) + own
+        # init_resreq < idle + eps per dim (less_equal's epsilon,
+        # resource_info.go:253-277). When everything fits — the invariant
+        # the kernel's capacity accounting guarantees — the whole set is
+        # applied via the batched session path; on drift (should not
+        # happen) fall back to the per-task guarded loop.
         T = len(ctx.tasks)
         a = np.asarray(assigned[:T])
         sel = np.nonzero(a >= 0)[0]
@@ -130,17 +133,19 @@ class AllocateTpuAction(Action):
         if sel.size:
             nodes_sel = a[sel]
             order = np.argsort(nodes_sel, kind="stable")
-            rows = ctx.task_fit_host[sel][order]
-            cum = np.cumsum(rows, axis=0)
+            req_rows = ctx.task_req_host[sel][order]
+            fit_rows = ctx.task_fit_host[sel][order]
+            cum = np.cumsum(req_rows, axis=0)
             seg_starts = np.nonzero(
                 np.diff(nodes_sel[order], prepend=-1)
             )[0]
             base = np.zeros_like(cum)
             base[seg_starts[1:]] = cum[seg_starts[1:] - 1]
-            cum -= np.maximum.accumulate(base, axis=0)
+            # exclusive within-node prefix of resreq consumption
+            prefix = cum - req_rows - np.maximum.accumulate(base, axis=0)
             idle = ctx.node_idle_host[nodes_sel[order]]
             eps = ctx.layout.eps().astype(np.float64)
-            all_fit = bool((cum < idle + eps).all())
+            all_fit = bool((prefix + fit_rows < idle + eps).all())
         if all_fit:
             placed = ssn.allocate_batch(
                 [(ctx.tasks[i], ctx.nodes[a[i]].name) for i in sel]
@@ -212,7 +217,7 @@ class AllocateTpuAction(Action):
             best = ssn.nodes[select_best_node(priority_list)]
             delta = best.idle.clone()
             delta.fit_delta(task.init_resreq)
-            job.nodes_fit_delta[best.name] = delta
+            job.record_fit_delta(best.name, delta)
             try:
                 ssn.pipeline(task, best.name)
             except Exception:

@@ -21,7 +21,12 @@ from .pod_info import (
     get_pod_resource_request,
     get_pod_resource_without_init_containers,
 )
-from .resource_info import RESOURCE_CPU, RESOURCE_MEMORY, Resource
+from .resource_info import (
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    Resource,
+    freeze_resource,
+)
 from .types import TaskStatus, allocated_status, validate_status_update
 
 TaskID = str
@@ -67,10 +72,21 @@ class TaskInfo:
         )
         self.volume_ready = False
         self.pod = pod
-        self.resreq: Resource = get_pod_resource_without_init_containers(pod)
-        self.init_resreq: Resource = get_pod_resource_request(pod)
+        # Frozen: clones share these (see TaskInfo.clone / FrozenResource).
+        self.resreq: Resource = freeze_resource(
+            get_pod_resource_without_init_containers(pod)
+        )
+        self.init_resreq: Resource = freeze_resource(
+            get_pod_resource_request(pod)
+        )
 
     def clone(self) -> "TaskInfo":
+        # resreq/init_resreq are immutable by contract — nothing in the
+        # package mutates a task's request vectors in place (aggregates
+        # like job.allocated / node.idle clone before add/sub), so clones
+        # SHARE them. With ~150k task clones per 50k-task cycle (snapshot
+        # + node bookkeeping), cloning the two Resource payloads per task
+        # was the single largest host cost of session open.
         c = object.__new__(TaskInfo)
         c.uid = self.uid
         c.job = self.job
@@ -81,8 +97,8 @@ class TaskInfo:
         c.priority = self.priority
         c.volume_ready = self.volume_ready
         c.pod = self.pod
-        c.resreq = self.resreq.clone()
-        c.init_resreq = self.init_resreq.clone()
+        c.resreq = self.resreq
+        c.init_resreq = self.init_resreq
         return c
 
     @property
@@ -120,6 +136,10 @@ class JobInfo:
         # part of the surface): a PodDisruptionBudget standing in for a
         # PodGroup.
         self.pdb = None
+        # Mutation counter: every state-changing method bumps it; the
+        # cache's snapshot clone pool reuses a clone only while both the
+        # source's and the clone's counters are unchanged (COW snapshots).
+        self._ver = 0
         for task in tasks:
             self.add_task_info(task)
 
@@ -127,6 +147,7 @@ class JobInfo:
 
     def set_pod_group(self, pg: PodGroup) -> None:
         """Attach PodGroup spec to the job (reference job_info.go:184-192)."""
+        self._ver += 1
         self.name = pg.name
         self.namespace = pg.namespace
         self.min_available = pg.spec.min_member
@@ -135,11 +156,13 @@ class JobInfo:
         self.pod_group = pg
 
     def unset_pod_group(self) -> None:
+        self._ver += 1
         self.pod_group = None
 
     # -- PDB (legacy gang source, reference job_info.go:194-207) ------------
 
     def set_pdb(self, pdb) -> None:
+        self._ver += 1
         self.name = pdb.name
         self.namespace = pdb.namespace
         self.min_available = pdb.min_available
@@ -147,6 +170,7 @@ class JobInfo:
         self.pdb = pdb
 
     def unset_pdb(self) -> None:
+        self._ver += 1
         self.pdb = None
 
     # -- task bookkeeping ---------------------------------------------------
@@ -163,6 +187,7 @@ class JobInfo:
 
     def add_task_info(self, ti: TaskInfo) -> None:
         """reference job_info.go:233-242"""
+        self._ver += 1
         self.tasks[ti.uid] = ti
         self._add_task_index(ti)
         self.total_request.add(ti.resreq)
@@ -177,6 +202,7 @@ class JobInfo:
                 f"failed to find task <{ti.namespace}/{ti.name}> "
                 f"in job <{self.namespace}/{self.name}>"
             )
+        self._ver += 1
         self.total_request.sub(task.resreq)
         if allocated_status(task.status):
             self.allocated.sub(task.resreq)
@@ -206,6 +232,7 @@ class JobInfo:
             task.status = status
             self.add_task_info(task)
             return
+        self._ver += 1
         self._delete_task_index(stored)
         was = allocated_status(stored.status)
         if was and not now:
@@ -245,6 +272,21 @@ class JobInfo:
             info.tasks[uid] = ti
             info._add_task_index(ti)
         return info
+
+    # -- fit diagnostics ----------------------------------------------------
+
+    def record_fit_delta(self, node_name: str, delta: Resource) -> None:
+        """Record missing-resource diagnostics for fit_error
+        (allocate.go:168-173). Mutator so the COW snapshot pool sees the
+        change — never write nodes_fit_delta directly."""
+        self._ver += 1
+        self.nodes_fit_delta[node_name] = delta
+
+    def clear_fit_deltas(self) -> None:
+        """Drop stale fit data (allocate.go:127-133)."""
+        if self.nodes_fit_delta:
+            self._ver += 1
+            self.nodes_fit_delta = {}
 
     # -- gang readiness -----------------------------------------------------
 

@@ -40,6 +40,9 @@ class NodeInfo:
         self.allocatable = Resource.empty()
         self.capability = Resource.empty()
         self.tasks: Dict[str, TaskInfo] = {}
+        # Mutation counter for the cache's COW snapshot pool (see
+        # JobInfo._ver): bumped by every accounting mutator.
+        self._ver = 0
         if node is not None:
             self.name = node.name
             self.node = node
@@ -55,6 +58,7 @@ class NodeInfo:
 
     def _set_node_state(self, node: Optional[Node]) -> None:
         """reference node_info.go:107-131"""
+        self._ver += 1
         if node is None:
             self.state = NodeState(NodePhase.NOT_READY, "UnInitialized")
             return
@@ -68,6 +72,7 @@ class NodeInfo:
     def set_node(self, node: Node) -> None:
         """Recompute accounting from a fresh node object
         (reference node_info.go:134-159)."""
+        self._ver += 1
         self._set_node_state(node)
         if not self.ready():
             return
@@ -103,6 +108,7 @@ class NodeInfo:
                 f"task <{task.namespace}/{task.name}> already on node <{self.name}>"
             )
         ti = task.clone()
+        self._ver += 1
         if self.node is not None:
             if ti.status == TaskStatus.RELEASING:
                 self._allocate_idle_resource(ti)
@@ -123,6 +129,7 @@ class NodeInfo:
                 f"failed to find task <{ti.namespace}/{ti.name}> "
                 f"on host <{self.name}>"
             )
+        self._ver += 1
         if self.node is not None:
             if task.status == TaskStatus.RELEASING:
                 self.releasing.sub(task.resreq)
@@ -147,6 +154,7 @@ class NodeInfo:
         are invariants of the task set) without re-parsing the node's
         quantity strings on every 1 Hz snapshot."""
         res = NodeInfo.__new__(NodeInfo)
+        res._ver = 0
         res.name = self.name
         res.node = self.node
         res.state = NodeState(self.state.phase, self.state.reason)

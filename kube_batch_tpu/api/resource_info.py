@@ -14,6 +14,7 @@ Semantics mirror the reference's pkg/scheduler/api/resource_info.go:
 from __future__ import annotations
 
 import re
+from types import MappingProxyType
 from typing import Dict, Iterable, List, Optional, Tuple, Union
 
 # Canonical resource names (k8s-compatible spellings).
@@ -323,6 +324,47 @@ class Resource:
         for name, quant in (self.scalar_resources or {}).items():
             parts.append(f"{name} {quant:.2f}")
         return ", ".join(parts)
+
+
+class FrozenResource(Resource):
+    """Immutable :class:`Resource` view.
+
+    Task request vectors (TaskInfo.resreq / init_resreq) are frozen at
+    construction so every clone on the snapshot/bookkeeping hot path can
+    SHARE them instead of deep-copying (~150k Resource copies per
+    50k-task cycle otherwise). Freezing makes the sharing safe by
+    construction: any in-place mutation attempt raises instead of
+    silently corrupting every holder. ``clone()`` (inherited) returns a
+    regular mutable Resource, so ``resreq.clone().add(...)`` patterns
+    keep working."""
+
+    __slots__ = ()
+
+    def _frozen(self, *args, **kwargs):
+        raise TypeError(
+            "Resource is frozen (task request vectors are shared across "
+            "clones); use clone() to get a mutable copy"
+        )
+
+    __setattr__ = _frozen
+    add = _frozen
+    sub = _frozen
+    multi = _frozen
+    set_max_resource = _frozen
+    fit_delta = _frozen
+    add_scalar = _frozen
+    set_scalar = _frozen
+
+
+def freeze_resource(r: Resource) -> Resource:
+    """Freeze in place (no copy): the scalar dict becomes a read-only
+    mapping view and the __class__ switches to the slots-compatible
+    immutable subclass, so both attribute rebinding AND in-place dict
+    mutation raise."""
+    if r.scalar_resources is not None:
+        r.scalar_resources = MappingProxyType(r.scalar_resources)
+    r.__class__ = FrozenResource
+    return r
 
 
 def min_resource(l: Resource, r: Resource) -> Resource:

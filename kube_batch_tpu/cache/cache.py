@@ -167,6 +167,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self._max_retry_delay = 5.0
         self._dispatch = self._build_dispatch()
 
+        # COW snapshot pool: {key: (src_ver, clone, clone_ver)} per kind
+        # (see snapshot()).
+        self._snap_pool: tuple = ({}, {})
+
         self._executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="cache-sideeffect"
         )
@@ -312,12 +316,40 @@ class SchedulerCache(Cache, EventHandlersMixin):
     # -- snapshot (reference cache.go:612-659) --------------------------------
 
     def snapshot(self) -> ClusterInfo:
+        """Deep-clone the schedulable world — with a copy-on-write pool.
+
+        The reference re-clones everything each 1 Hz cycle
+        (cache.go:612-659); at 50k tasks that alone busts the cycle
+        budget (SURVEY §7 hard part (e)). Here each clone is cached and
+        REUSED while (a) its source object hasn't changed — every
+        JobInfo/NodeInfo mutator bumps ``_ver`` — and (b) the clone
+        itself wasn't mutated by the session it was handed to (session
+        allocate/pipeline/evict bump the clone's ``_ver``). Either bump
+        forces a fresh clone, so cache state can never leak into or out
+        of a session. Consequence of reuse: clones are shared between
+        CONSECUTIVE snapshots when nothing changed in between — valid
+        because a snapshot's objects are only ever mutated by its own
+        session, and the scheduler runs sessions strictly one at a time
+        (reference semantics: one runOnce per cycle, scheduler.go:84)."""
         with self.mutex:
             snap = ClusterInfo()
+            pool_jobs: Dict[str, tuple] = {}
+            pool_nodes: Dict[str, tuple] = {}
+            old_jobs, old_nodes = self._snap_pool
             for name, node in self.nodes.items():
                 if not node.ready():
                     continue
-                snap.nodes[name] = node.clone()
+                entry = old_nodes.get(name)
+                if (
+                    entry is not None
+                    and entry[0] == node._ver
+                    and entry[2] == entry[1]._ver
+                ):
+                    clone = entry[1]
+                else:
+                    clone = node.clone()
+                pool_nodes[name] = (node._ver, clone, clone._ver)
+                snap.nodes[name] = clone
             for name, q in self.queues.items():
                 snap.queues[name] = q.clone()
             for key, job in self.jobs.items():
@@ -333,7 +365,20 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     )
                     if pc is not None:
                         job.priority = pc.value
-                snap.jobs[key] = job.clone()
+                entry = old_jobs.get(key)
+                if (
+                    entry is not None
+                    and entry[0] == job._ver
+                    and entry[2] == entry[1]._ver
+                    and entry[1].priority == job.priority
+                ):
+                    clone = entry[1]
+                else:
+                    clone = job.clone()
+                pool_jobs[key] = (job._ver, clone, clone._ver)
+                snap.jobs[key] = clone
+            # Entries for deleted objects fall away with the pool swap.
+            self._snap_pool = (pool_jobs, pool_nodes)
             return snap
 
     # -- side effects --------------------------------------------------------
@@ -428,7 +473,12 @@ class SchedulerCache(Cache, EventHandlersMixin):
             for ti in task_infos:
                 try:
                     item = self._bind_bookkeeping(ti, ti.node_name)
-                    if item[2].volume_ready:
+                    # Volume readiness lives on the CALLER's (session)
+                    # task — the cache-side clone never sees the session's
+                    # allocate/bind_volumes writes. Propagate it so the
+                    # async side effect doesn't re-wait on ready volumes.
+                    item[2].volume_ready = ti.volume_ready
+                    if ti.volume_ready:
                         binds.append(item)
                     else:
                         slow_binds.append(item)

@@ -163,7 +163,7 @@ def sharded_step(
     mesh: Mesh,
     max_rounds: int = 256,
     staged=None,
-    tail_bucket: int = 6144,
+    tail_bucket: int = 3072,
 ):
     """Return ``(step_fn, device_inputs)``: inputs padded and device_put
     onto the mesh ONCE, plus the cached jitted step to run on them. Use
@@ -181,7 +181,7 @@ def solve_sharded(
     mesh: Mesh = None,
     max_rounds: int = 256,
     staged=None,
-    tail_bucket: int = 6144,
+    tail_bucket: int = 3072,
 ):
     """Run the batched solve with the node axis sharded over ``mesh``.
 

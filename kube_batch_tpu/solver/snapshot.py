@@ -94,9 +94,11 @@ class SnapshotContext:
     queues: List[QueueInfo]
     mask: Optional["CombinedMask"] = None  # host-side feasibility rows
     # Unpadded host copies for the vectorized apply-phase fit guard
-    # (task init_resreq rows [T,R] and node idle [N,R], float64 so
-    # cumulative sums stay exact against the epsilon comparisons).
+    # (float64 so cumulative sums stay exact against the epsilon
+    # comparisons): init_resreq rows (each task's own fit requirement),
+    # resreq rows (what node accounting actually subtracts), node idle.
     task_fit_host: Optional[np.ndarray] = None
+    task_req_host: Optional[np.ndarray] = None
     node_idle_host: Optional[np.ndarray] = None
 
 
@@ -439,6 +441,7 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
     )
     ctx = SnapshotContext(
         layout, tasks, nodes, queue_order, mask,
-        task_fit_host=fit_mat[order], node_idle_host=node_idle64,
+        task_fit_host=fit_mat[order], task_req_host=req_mat[order],
+        node_idle_host=node_idle64,
     )
     return inputs, ctx

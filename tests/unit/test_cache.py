@@ -194,7 +194,13 @@ class TestSnapshot:
         node.state.phase = "NotReady"
         for t in node.tasks.values():
             t.status = TaskStatus.RELEASING
-            t.resreq.milli_cpu = 99999
+            # Task request vectors are FROZEN (shared across clones);
+            # mutation attempts must raise instead of corrupting every
+            # holder — the strongest form of the tripwire.
+            with pytest.raises(TypeError):
+                t.resreq.milli_cpu = 99999
+            with pytest.raises(TypeError):
+                t.resreq.add(req_resource())
         job = snap.jobs["ns/pg1"]
         job.total_request.add(req_resource())
         job.allocated.add(req_resource())
@@ -204,10 +210,26 @@ class TestSnapshot:
         ]
         job.update_task_status(pending[0], TaskStatus.ALLOCATED)
         for t in job.tasks.values():
-            t.resreq.scalar_resources = {"x": 1.0}
+            with pytest.raises(TypeError):
+                t.resreq.scalar_resources = {"x": 1.0}
         for q in snap.queues.values():
             q.weight = 99
         assert fingerprint() == before
+
+    def test_frozen_scalar_dict_rejects_entry_mutation(self):
+        """In-place dict-entry writes on a frozen request vector must
+        raise too (clones share the dict via MappingProxyType)."""
+        c = make_cache()
+        c.add_pod_group(build_pod_group("pg1", namespace="ns"))
+        c.add_pod(build_pod(
+            "ns", "p1", "", PodPhase.PENDING,
+            build_resource_list(cpu="1", **{"nvidia.com/gpu": 1}),
+            group_name="pg1"))
+        snap = c.snapshot()
+        t = next(iter(snap.jobs["ns/pg1"].tasks.values()))
+        assert t.resreq.scalar_resources
+        with pytest.raises(TypeError):
+            t.resreq.scalar_resources["nvidia.com/gpu"] = 99.0
 
     def test_snapshot_skips_not_ready_nodes_and_specless_jobs(self):
         c = make_cache()
@@ -271,3 +293,81 @@ class TestSideEffects:
         assert c.nodes["n1"].releasing.milli_cpu == 1000
         key = c.evictor.channel.get(timeout=3)
         assert key == "ns/p1"
+
+
+class TestSnapshotPool:
+    """COW snapshot pool: unchanged objects are reused across consecutive
+    snapshots; any mutation of source OR handed-out clone forces a fresh
+    clone (so session state can never leak between cycles)."""
+
+    def _cache(self):
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=1))
+        for j in range(3):
+            c.add_node(build_node(
+                f"n{j}", build_resource_list(cpu="4", memory="8Gi")))
+        for g in range(2):
+            c.add_pod_group(build_pod_group(
+                f"pg{g}", namespace="ns", queue="q1"))
+            for i in range(2):
+                c.add_pod(build_pod(
+                    "ns", f"pg{g}-p{i}", "", PodPhase.PENDING, req(),
+                    group_name=f"pg{g}"))
+        return c
+
+    def test_unchanged_objects_reused(self):
+        c = self._cache()
+        s1 = c.snapshot()
+        s2 = c.snapshot()
+        assert s2.jobs["ns/pg0"] is s1.jobs["ns/pg0"]
+        assert s2.nodes["n0"] is s1.nodes["n0"]
+
+    def test_clone_mutation_forces_fresh_clone(self):
+        c = self._cache()
+        s1 = c.snapshot()
+        job = s1.jobs["ns/pg0"]
+        task = next(iter(job.tasks.values()))
+        job.update_task_status(task, TaskStatus.ALLOCATED)  # session-like
+        s2 = c.snapshot()
+        assert s2.jobs["ns/pg0"] is not job
+        # and the fresh clone reflects CACHE truth, not the session edit
+        t2 = s2.jobs["ns/pg0"].tasks[task.uid]
+        assert t2.status == TaskStatus.PENDING
+
+    def test_source_mutation_forces_fresh_clone(self):
+        c = self._cache()
+        s1 = c.snapshot()
+        c.add_pod(build_pod("ns", "pg0-p9", "", PodPhase.PENDING, req(),
+                            group_name="pg0"))
+        s2 = c.snapshot()
+        assert s2.jobs["ns/pg0"] is not s1.jobs["ns/pg0"]
+        assert "pg0-p9" in {t.name for t in s2.jobs["ns/pg0"].tasks.values()}
+        # untouched job still reused
+        assert s2.jobs["ns/pg1"] is s1.jobs["ns/pg1"]
+
+    def test_node_accounting_isolated_across_cycles(self):
+        c = self._cache()
+        s1 = c.snapshot()
+        node = s1.nodes["n0"]
+        task = next(iter(s1.jobs["ns/pg0"].tasks.values()))
+        s1.jobs["ns/pg0"].update_task_status(task, TaskStatus.ALLOCATED)
+        task.node_name = "n0"
+        node.add_task(task)
+        s2 = c.snapshot()
+        assert s2.nodes["n0"] is not node
+        assert s2.nodes["n0"].idle.milli_cpu == 4000
+
+    def test_priority_class_change_invalidates(self):
+        c = self._cache()
+        c.add_pod_group(build_pod_group(
+            "pgp", namespace="ns", queue="q1",
+            priority_class_name="high"))
+        c.add_pod(build_pod("ns", "pgp-p0", "", PodPhase.PENDING, req(),
+                            group_name="pgp"))
+        s1 = c.snapshot()
+        assert s1.jobs["ns/pgp"].priority == 0
+        c.add_priority_class(
+            PriorityClass(metadata=ObjectMeta(name="high"), value=100)
+        )
+        s2 = c.snapshot()
+        assert s2.jobs["ns/pgp"].priority == 100
