@@ -29,9 +29,12 @@ perf:
 bench:
 	$(PY) bench.py
 
-# Static checks: compileall as the gofmt/golint analog.
+# Static checks (reference verify: gofmt/goimports/golint,
+# Makefile:13-17): byte-compile + the AST lint (unused/duplicate
+# imports, star imports, syntax).
 verify:
 	$(PY) -m compileall -q kube_batch_tpu tests bench.py __graft_entry__.py
+	$(PY) tools/lint.py
 
 # The exact CI pipeline (.github/workflows/ci.yml), runnable locally:
 # verify -> native -> test -> perf smoke -> bench smoke

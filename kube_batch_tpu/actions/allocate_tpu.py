@@ -64,6 +64,14 @@ def _use_native_solver() -> bool:
         return True
     if forced == "jax":
         return False
+    # Guarded backend access: a cold in-process jax.devices() with a
+    # wedged tunnel plugin registered hangs forever — the scheduling
+    # loop must never take that risk (probe happens in a bounded
+    # subprocess at most once per process; wedged → CPU + native).
+    from ..utils.backend import ensure_live_backend
+
+    if ensure_live_backend() == 0:
+        return True
     import jax
 
     if jax.devices()[0].platform != "cpu":

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from .helpers import get_task_status, pod_key
+from .helpers import get_task_status
 from .objects import (
     GROUP_NAME_ANNOTATION_KEY,
     Pod,

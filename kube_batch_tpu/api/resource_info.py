@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import re
 from types import MappingProxyType
-from typing import Dict, Iterable, List, Optional, Tuple, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 # Canonical resource names (k8s-compatible spellings).
 RESOURCE_CPU = "cpu"

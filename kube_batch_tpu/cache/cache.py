@@ -32,7 +32,6 @@ from ..api import (
     Pod,
     PodCondition,
     PodGroup,
-    PodGroupPhase,
     PriorityClass,
     Queue,
     QueueInfo,

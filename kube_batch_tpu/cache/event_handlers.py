@@ -25,7 +25,7 @@ from ..api import (
     TaskInfo,
     TaskStatus,
 )
-from .util import create_shadow_pod_group, job_terminated, shadow_pod_group
+from .util import create_shadow_pod_group, job_terminated
 
 logger = logging.getLogger(__name__)
 

@@ -9,7 +9,7 @@ substrate: a YAML snapshot loaded into the in-process cluster).
 from __future__ import annotations
 
 import argparse
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
 
 from ..api.objects import DEFAULT_SCHEDULER_NAME
@@ -43,6 +43,9 @@ class ServerOption:
     print_version: bool = False
     simulate_kubelet: bool = True
     once: bool = False               # run one cycle and exit (debugging aid)
+    # Bounded accelerator-backend probe at startup (seconds); a wedged
+    # tunnel must cost one startup delay, not a frozen scheduling loop.
+    backend_probe_timeout: int = 60
 
     def check_option_or_die(self) -> None:
         """reference options.go:83-89"""
@@ -116,6 +119,12 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
         "--once", action="store_true", default=False,
         help="Run a single scheduling cycle and exit")
     parser.add_argument(
+        "--backend-probe-timeout", type=int, default=60,
+        help="Seconds to wait for the accelerator backend to resolve at "
+             "startup (in a bounded subprocess); on timeout the scheduler "
+             "forces CPU devices and native solver routing instead of "
+             "risking a frozen first cycle")
+    parser.add_argument(
         "--version", action="store_true", default=False,
         help="Show version and quit")
 
@@ -139,4 +148,5 @@ def parse_options(argv: Optional[List[str]] = None) -> ServerOption:
         print_version=ns.version,
         simulate_kubelet=ns.simulate_kubelet,
         once=ns.once,
+        backend_probe_timeout=ns.backend_probe_timeout,
     )

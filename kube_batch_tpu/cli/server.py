@@ -217,19 +217,26 @@ class KubeLeaseElector(LeaderElector):
         self._renew_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.is_leader = False
+        # True once this identity has EVER held the lease. release()
+        # keys on this, not on the last attempt's is_leader: a transient
+        # API failure (or lost CAS) right before shutdown flips
+        # is_leader False while the API server still records us as
+        # holder — skipping release then forces the successor to wait
+        # out the full lease_duration (r2 advisor).
+        self.held_at_least_once = False
 
     def try_acquire(self) -> bool:
         if self._stop.is_set():
             # release() is clearing the lease: an in-flight renew must
-            # not re-acquire it for the dying identity. is_leader stays
-            # untouched — release() still needs it true to know the
-            # holder must be cleared.
+            # not re-acquire it for the dying identity.
             return False
         try:
             self.is_leader = self.cluster.try_acquire_lease(
                 self.namespace, self.name, self.identity,
                 self.lease_duration,
             )
+            if self.is_leader:
+                self.held_at_least_once = True
         except Exception:
             # Transient API failure: this attempt fails; the renew loop's
             # renew_deadline decides when failing attempts lose leadership.
@@ -245,7 +252,9 @@ class KubeLeaseElector(LeaderElector):
         # dying process for the full lease_duration.
         if self._renew_thread is not None:
             self._renew_thread.join(timeout=10.0)
-        if self.is_leader:
+        if self.held_at_least_once:
+            # release_lease clears the holder only if it is still this
+            # identity, so releasing after a genuine takeover is a no-op.
             self.cluster.release_lease(
                 self.namespace, self.name, self.identity
             )
@@ -282,6 +291,17 @@ def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
         scheduler_conf=opt.scheduler_conf or None,
         schedule_period=opt.schedule_period,
     )
+
+    # Resolve the accelerator backend ONCE, bounded, before the first
+    # cycle: a wedged tunnel plugin would otherwise hang the loop at its
+    # first in-process jax call (bench/tests/graft entries already probe
+    # this way; the daemon needs the same discipline). Wedged → CPU
+    # devices + native solver routing, loudly.
+    if any(a.name() == "allocate_tpu" for a in sched.actions):
+        from ..utils.backend import ensure_live_backend
+
+        devices = ensure_live_backend(timeout=opt.backend_probe_timeout)
+        logger.info("jax backend ready: %d device(s)", devices)
 
     http_server, _ = start_metrics_server(opt.listen_address)
     stop = stop_event or threading.Event()

@@ -50,9 +50,9 @@ class ClusterAPI:
 
     # -- volume claims (optional capability) --------------------------------
     # Default: no claim store — volumes are instantly assumable and never
-    # block binds (the real-cluster adapter inherits these; the k8s PV
-    # controller owns binding there). InProcessCluster overrides with a
-    # real assume/bind lifecycle.
+    # block binds. InProcessCluster overrides with a real assume/bind
+    # lifecycle; KubeCluster implements the same contract against live
+    # PVC phases (watch-fed store + GET fallback).
 
     def assume_pod_volumes(self, pod: Pod, hostname: str) -> bool:
         return True  # all claims "already bound"

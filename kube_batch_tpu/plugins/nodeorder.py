@@ -18,7 +18,6 @@ from ..api import NodeInfo, TaskInfo
 from ..framework import Plugin, register_plugin_builder
 from .util import (
     match_affinity_term,
-    match_label_selector,
     match_node_selector_terms,
 )
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..api import NodeInfo, Pod, TaskInfo, TaskStatus
+from ..api import TaskInfo, TaskStatus
 
 
 class PredicateError(Exception):

@@ -122,7 +122,16 @@ def _bid_kernel(
     ).astype(jnp.int32)
     key = jnp.where(mask, (q << _KEY_HASH_BITS) | h, -1)
 
-    bid_ref[:] = jnp.argmax(key, axis=1).astype(jnp.int32)[:, None]
+    # Row argmax without the argmax primitive: Mosaic's index-reduction
+    # lowering is float32-only (r3 hardware validation hit
+    # `NotImplementedError: Only float32 is supported`), but plain
+    # min/max reductions on int32 lower fine — take the row max, then
+    # the first column achieving it (argmax's tie rule).
+    row_max = jnp.max(key, axis=1)                        # i32[TILE_T]
+    is_max = key == row_max[:, None]
+    bid_ref[:] = jnp.min(
+        jnp.where(is_max, n_ids.astype(jnp.int32), N), axis=1
+    ).astype(jnp.int32)[:, None]
     any_ref[:] = jnp.any(mask, axis=1)[:, None]
 
 

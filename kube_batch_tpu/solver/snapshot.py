@@ -34,9 +34,6 @@ from ..api.resource_info import (
     MIN_MEMORY,
     MIN_MILLI_CPU,
     MIN_MILLI_SCALAR,
-    RESOURCE_CPU,
-    RESOURCE_MEMORY,
-    share as share_fn,
 )
 
 MIB = 2.0**20

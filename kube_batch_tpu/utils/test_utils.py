@@ -23,13 +23,11 @@ from ..api import (
     Pod,
     PodGroup,
     PodGroupSpec,
-    PodPhase,
     PodSpec,
     PodStatus,
     Queue,
     QueueSpec,
     ResourceList,
-    build_resource_list,
 )
 
 

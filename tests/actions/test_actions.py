@@ -9,7 +9,6 @@ run the action, then assert bindings/evictions by draining the fake channels.
 
 import queue as queue_mod
 
-import pytest
 
 import kube_batch_tpu.actions  # noqa: F401 - registers actions
 import kube_batch_tpu.plugins  # noqa: F401 - registers plugins

@@ -4,7 +4,6 @@ nodeorder.go) — the real Scheduler loop against the in-process cluster.
 Each spec mirrors a reference Ginkgo It(...) block; citations inline.
 """
 
-import pytest
 
 from kube_batch_tpu.api import PodPhase, build_resource_list
 from kube_batch_tpu.api.objects import Affinity, PodGroupPhase, Taint, Toleration

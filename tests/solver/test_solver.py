@@ -15,7 +15,6 @@ import kube_batch_tpu.actions  # noqa: F401
 import kube_batch_tpu.plugins  # noqa: F401
 from kube_batch_tpu.api import PodPhase, build_resource_list
 from kube_batch_tpu.solver import (
-    SolverInputs,
     less_equal,
     make_inputs,
     segmented_cumsum,

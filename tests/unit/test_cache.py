@@ -1,7 +1,5 @@
 """SchedulerCache tests (port of reference cache/cache_test.go:128-309)."""
 
-import time
-
 import pytest
 
 from kube_batch_tpu.api import (

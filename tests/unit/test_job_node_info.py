@@ -7,9 +7,7 @@ from kube_batch_tpu.api import (
     Container,
     JobInfo,
     NodeInfo,
-    Pod,
     PodPhase,
-    Resource,
     TaskInfo,
     TaskStatus,
     build_resource_list,
