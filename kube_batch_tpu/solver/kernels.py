@@ -286,10 +286,13 @@ _KEY_HASH_BITS = 10
 _KEY_BIAS = 1 << 19  # centers the quantized range so negative scores rank
 
 # Conflict-resolution commits per score pass (see _solve_round): each
-# extra commit costs two O(T log T) sorts against the round's one
-# O(T*N) score matrix, and lets prefix-race losers cascade to their
-# next-best node without waiting for the next round.
-COMMITS_PER_ROUND = 3
+# extra commit costs one [T, N] argmax + two O(T log T) sorts against
+# the round's full mask/score/key build, and lets prefix-race losers
+# cascade to their next-best node without waiting for the next round.
+# Measured at 50k x 5k: 6 commits converge in 3 rounds vs 6 rounds at 3
+# commits, identical placement — halving the expensive full-width
+# passes.
+COMMITS_PER_ROUND = 6
 
 
 def _bid_hash(t_idx: jnp.ndarray, n_idx: jnp.ndarray) -> jnp.ndarray:
@@ -544,11 +547,14 @@ def _solve_round(
     def commit_once(_, state):
         assigned, idle, ntask, qalloc, any_acc, key = state
         live = (assigned < 0)
-        key_eff = jnp.where(live[:, None], key, -1)
-        has_bid = jnp.any(key_eff >= 0, axis=1)
-        bid = jnp.where(
-            has_bid, jnp.argmax(key_eff, axis=1).astype(jnp.int32), N
-        )
+        # One [T, N] argmax over the PERSISTENT key matrix; rows of
+        # already-assigned tasks produce garbage bids that the O(T)
+        # has_bid gate discards — cheaper than materializing a
+        # where(live) copy plus a full-width any() per commit (for live
+        # rows the result is identical).
+        bid_col = jnp.argmax(key, axis=1).astype(jnp.int32)
+        has_bid = live & (key[arange_t, bid_col] >= 0)
+        bid = jnp.where(has_bid, bid_col, N)
         assigned, idle, ntask, qalloc, acc = _commit_bids(
             bid, assigned, idle, ntask, qalloc,
             task_req=task_req, task_fit=task_fit,
@@ -559,7 +565,7 @@ def _solve_round(
         # Losers stop re-bidding the column they just lost this round
         # (fresh scores next round may still pick it).
         lost = has_bid & (assigned < 0)
-        col = jnp.where(has_bid, bid, 0)
+        col = jnp.where(has_bid, bid_col, 0)
         key = key.at[arange_t, col].set(
             jnp.where(lost, -1, key[arange_t, col])
         )
