@@ -11,6 +11,7 @@ Mirrors reference pkg/scheduler/api/node_info.go:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -19,6 +20,8 @@ from .job_info import TaskInfo
 from .objects import Node, Pod
 from .resource_info import Resource
 from .types import NodePhase, TaskStatus
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -119,6 +122,76 @@ class NodeInfo:
                 self._allocate_idle_resource(ti)
             self.used.add(ti.resreq)
         self.tasks[key] = ti
+
+    def add_tasks(self, tasks: List[TaskInfo]) -> None:
+        """Batched :meth:`add_task` for same-status bulk placement (the
+        apply phase): one aggregate idle/used update for the whole group
+        instead of per-task Resource arithmetic. Only statuses on the
+        default accounting branch (not Releasing/Pipelined) qualify, and
+        pod keys must be unique across both the node and the batch.
+
+        All-or-nothing: on any precondition failure it raises WITHOUT
+        touching node state — notably, a failed aggregate fit check does
+        NOT mark the node OutOfSync, because the single group epsilon is
+        stricter than the per-task epsilon chain and the per-task
+        fallback may still place everything on a healthy node."""
+        if not tasks:
+            return
+        clones = []
+        seen = set()
+        for task in tasks:
+            key = pod_key(task.pod)
+            if key in self.tasks or key in seen:
+                raise ValueError(
+                    f"task <{task.namespace}/{task.name}> already on "
+                    f"node <{self.name}>"
+                )
+            seen.add(key)
+            if task.status in (TaskStatus.RELEASING, TaskStatus.PIPELINED):
+                raise ValueError(
+                    f"add_tasks only takes default-branch statuses, got "
+                    f"{task.status.name}"
+                )
+            clones.append((key, task.clone()))
+        if self.node is not None:
+            delta = Resource.empty()
+            for _, ti in clones:
+                delta.add(ti.resreq)
+            if not delta.less_equal(self.idle):
+                raise ValueError(
+                    f"batch of {len(clones)} tasks does not fit node "
+                    f"<{self.name}> in aggregate"
+                )
+            self.idle.sub(delta)
+            self.used.add(delta)
+        self._ver += 1
+        for key, ti in clones:
+            self.tasks[key] = ti
+
+    def add_tasks_with_fallback(self, tasks: List[TaskInfo]) -> List[TaskInfo]:
+        """Batch-add with sequential per-task fallback, returning the
+        tasks actually placed. The fallback covers the cases the strict
+        batch path rejects (aggregate epsilon, mixed statuses, duplicate
+        keys): per-task failures are logged and skipped, exactly like the
+        sequential apply loop. Shared by Session.allocate_batch and
+        SchedulerCache.bind_batch so the fallback policy lives next to
+        the accounting it protects."""
+        try:
+            self.add_tasks(tasks)
+            return list(tasks)
+        except Exception:
+            placed: List[TaskInfo] = []
+            for task in tasks:
+                try:
+                    self.add_task(task)
+                except Exception:
+                    logger.exception(
+                        "failed to place task <%s/%s> on node <%s>",
+                        task.namespace, task.name, self.name,
+                    )
+                    continue
+                placed.append(task)
+            return placed
 
     def remove_task(self, ti: TaskInfo) -> None:
         """reference node_info.go:209-235"""

@@ -392,10 +392,12 @@ class SchedulerCache(Cache, EventHandlersMixin):
             raise KeyError(f"failed to find task <{ti.namespace}/{ti.name}>")
         return job, task
 
-    def _bind_bookkeeping(self, task_info: TaskInfo, hostname: str):
-        """Under-mutex half of bind: validate, move to Binding, account on
-        the node. Returns (pod, hostname, task clone) for the side effect.
-        Caller must hold self.mutex."""
+    def _bind_bookkeeping(self, task_info: TaskInfo, hostname: str,
+                          add_to_node: bool = True):
+        """Under-mutex half of bind: validate, move to Binding, and (by
+        default) account on the node. Returns the STORED task. Caller
+        must hold self.mutex. ``add_to_node=False`` defers the node
+        accounting to the caller (bind_batch groups it per node)."""
         job, task = self._find_job_and_task(task_info)
         node = self.nodes.get(hostname)
         if node is None:
@@ -410,8 +412,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
             )
         job.update_task_status(task, TaskStatus.BINDING)
         task.node_name = hostname
-        node.add_task(task)
-        return task.pod, hostname, task.clone()
+        if add_to_node:
+            node.add_task(task)
+        return task
 
     def _bind_side_effect(self, pod, hostname, task_snapshot) -> None:
         """Async half of bind. The volume bind wait (up to the reference's
@@ -440,9 +443,8 @@ class SchedulerCache(Cache, EventHandlersMixin):
     def bind(self, task_info: TaskInfo, hostname: str) -> None:
         """reference cache.go:480-522"""
         with self.mutex:
-            pod, hostname, task_snapshot = self._bind_bookkeeping(
-                task_info, hostname
-            )
+            task = self._bind_bookkeeping(task_info, hostname)
+            pod, task_snapshot = task.pod, task.clone()
 
         if self.binder is not None:
             self._submit_side_effect(
@@ -469,23 +471,41 @@ class SchedulerCache(Cache, EventHandlersMixin):
         slow_binds = []  # volume wait possible: isolate per task
         bound = []
         with self.mutex:
+            staged: Dict[str, list] = {}  # hostname -> [(ti, stored)]
             for ti in task_infos:
                 try:
-                    item = self._bind_bookkeeping(ti, ti.node_name)
-                    # Volume readiness lives on the CALLER's (session)
-                    # task — the cache-side clone never sees the session's
-                    # allocate/bind_volumes writes. Propagate it so the
-                    # async side effect doesn't re-wait on ready volumes.
-                    item[2].volume_ready = ti.volume_ready
-                    if ti.volume_ready:
-                        binds.append(item)
-                    else:
-                        slow_binds.append(item)
-                    bound.append(ti)
+                    stored = self._bind_bookkeeping(
+                        ti, ti.node_name, add_to_node=False
+                    )
+                    staged.setdefault(ti.node_name, []).append((ti, stored))
                 except Exception:
                     logger.exception(
                         "failed to bind task %s/%s", ti.namespace, ti.name
                     )
+
+            def accept(ti, stored, hostname):
+                snapshot = stored.clone()
+                # Volume readiness lives on the CALLER's (session) task —
+                # the cache-side clone never sees the session's
+                # allocate/bind_volumes writes. Propagate it so the async
+                # side effect doesn't re-wait on ready volumes.
+                snapshot.volume_ready = ti.volume_ready
+                item = (stored.pod, hostname, snapshot)
+                (binds if ti.volume_ready else slow_binds).append(item)
+                bound.append(ti)
+
+            # Node accounting grouped per node (one aggregate idle/used
+            # update; fallback policy in NodeInfo.add_tasks_with_fallback).
+            for hostname, items in staged.items():
+                node = self.nodes[hostname]
+                ok = {
+                    id(s) for s in node.add_tasks_with_fallback(
+                        [stored for _, stored in items]
+                    )
+                }
+                for ti, stored in items:
+                    if id(stored) in ok:
+                        accept(ti, stored, hostname)
 
         if self.binder is not None:
             def _do_binds(chunk):

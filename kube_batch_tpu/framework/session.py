@@ -225,8 +225,7 @@ class Session:
         - per-task failures are logged and skipped, not fatal.
 
         Returns the number of tasks allocated."""
-        events: List[Event] = []
-        jobs_touched: Dict[str, JobInfo] = {}
+        staged: Dict[str, list] = {}  # hostname -> [(task, job)]
         for task, hostname in pairs:
             job = self.jobs.get(task.job)
             if job is None:
@@ -240,14 +239,29 @@ class Session:
                 self.cache.allocate_volumes(task, hostname)
                 job.update_task_status(task, TaskStatus.ALLOCATED)
                 task.node_name = hostname
-                node.add_task(task)
             except Exception:
                 logger.exception(
                     "Failed to allocate Task %s on %s", task.uid, hostname
                 )
                 continue
-            events.append(Event(task))
-            jobs_touched[job.uid] = job
+            staged.setdefault(hostname, []).append((task, job))
+
+        # Node accounting per NODE, not per task: one aggregate
+        # idle/used update for each node's group, with the per-task
+        # fallback policy in NodeInfo.add_tasks_with_fallback.
+        events: List[Event] = []
+        jobs_touched: Dict[str, JobInfo] = {}
+        for hostname, items in staged.items():
+            node = self.nodes[hostname]
+            ok = {
+                id(t) for t in node.add_tasks_with_fallback(
+                    [t for t, _ in items]
+                )
+            }
+            for task, job in items:
+                if id(task) in ok:
+                    events.append(Event(task))
+                    jobs_touched[job.uid] = job
         if not events:
             return 0
         for eh in self.event_handlers:

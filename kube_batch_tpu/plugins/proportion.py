@@ -17,7 +17,6 @@ from typing import Dict
 from ..api import (
     QueueInfo,
     Resource,
-    allocated_status,
     min_resource,
     share as share_fn,
 )
@@ -69,14 +68,16 @@ class ProportionPlugin(Plugin):
                     queue.uid, queue.name, queue.weight
                 )
             attr = self.queue_attrs[job.queue]
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
-                        attr.request.add(t.resreq)
-                elif status == TaskStatus.PENDING:
-                    for t in tasks.values():
-                        attr.request.add(t.resreq)
+            # allocated-status sum == the maintained JobInfo.allocated
+            # aggregate; only the PENDING index still needs a per-task
+            # walk (request = allocated + pending). Steady-state session
+            # opens stop re-summing every placed task.
+            attr.allocated.add(job.allocated)
+            attr.request.add(job.allocated)
+            for t in job.task_status_index.get(
+                TaskStatus.PENDING, {}
+            ).values():
+                attr.request.add(t.resreq)
 
         # Water-filling (reference :100-147).
         remaining = self.total_resource.clone()

@@ -201,3 +201,65 @@ class TestNodeInfo:
         ni.set_node(bigger)
         assert ni.idle.milli_cpu == 15000
         assert ni.used.milli_cpu == 1000
+
+
+class TestBatchNodeAccounting:
+    """NodeInfo.add_tasks / add_tasks_with_fallback invariants (r3
+    review findings): strict batch path never mutates state on failure,
+    duplicate keys within one batch are rejected, and the fallback
+    leaves a healthy node Ready."""
+
+    def _node(self, cpu="4"):
+        return NodeInfo(build_node("n1", build_resource_list(
+            cpu=cpu, memory="8Gi")))
+
+    def _task(self, name, cpu="1"):
+        from kube_batch_tpu.api import TaskInfo
+        return TaskInfo(build_pod(
+            "ns", name, "", PodPhase.PENDING,
+            build_resource_list(cpu=cpu, memory="1Gi")))
+
+    def test_add_tasks_matches_sequential(self):
+        a, b = self._node(), self._node()
+        tasks = [self._task(f"p{i}") for i in range(3)]
+        a.add_tasks(tasks)
+        for t in tasks:
+            b.add_task(t)
+        assert a.idle.milli_cpu == b.idle.milli_cpu
+        assert a.used.milli_cpu == b.used.milli_cpu
+        assert sorted(a.tasks) == sorted(b.tasks)
+
+    def test_duplicate_key_in_batch_rejected_without_mutation(self):
+        n = self._node()
+        t = self._task("p0")
+        idle_before = n.idle.milli_cpu
+        with pytest.raises(ValueError):
+            n.add_tasks([t, t.clone()])
+        assert n.idle.milli_cpu == idle_before
+        assert not n.tasks
+        assert n.ready()
+
+    def test_batch_reject_leaves_node_ready_and_unmutated(self):
+        # The strict batch path must reject without poisoning the node:
+        # the fallback (or a later cycle) may still use it. (Per-dim
+        # arithmetic makes "aggregate rejects what the sequential chain
+        # accepts" unreachable for positive requests — overshoot can
+        # only happen on the final accepted step, where both checks
+        # agree — so the fallback is a safety net, not a hot path.)
+        n = self._node(cpu="2")
+        idle_before = n.idle.milli_cpu
+        with pytest.raises(ValueError):
+            n.add_tasks([self._task(f"p{i}", cpu="1") for i in range(3)])
+        assert n.ready()
+        assert n.idle.milli_cpu == idle_before
+        assert not n.tasks
+
+    def test_genuine_overflow_marks_out_of_sync_like_reference(self):
+        # A task that truly does not fit marks the node OutOfSync via the
+        # sequential path (reference node_info.go:161-171) — the batch
+        # fallback preserves that.
+        n = self._node(cpu="2")
+        tasks = [self._task(f"p{i}", cpu="1") for i in range(3)]
+        placed = n.add_tasks_with_fallback(tasks)
+        assert len(placed) == 2
+        assert not n.ready()  # OutOfSync: accounting genuinely overflowed
