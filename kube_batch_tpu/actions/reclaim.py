@@ -61,39 +61,74 @@ class ReclaimAction(Action):
                 continue
             task = tasks.pop()
 
-            # Deliberate divergence from reclaim.go: skip eviction when the
-            # claimant already fits free (idle or releasing) capacity on a
-            # feasible node — allocate, which runs after reclaim in the
-            # default policy, will place it this same cycle. The reference
-            # lacks this guard and relies on slow real-cluster pod deletion
-            # to not over-evict; with an instant substrate it would drain
-            # the victim queue far below its deserved share (contradicting
-            # its own e2e contract, test/e2e/queue.go:26-69).
-            fits_free = False
+            # One predicate pass: the feasible-node list feeds both the
+            # skip guard and the eviction scan (the old code ran
+            # predicates twice per claimant per cycle).
+            feasible = []
             for node in get_node_list(ssn.nodes):
                 try:
                     ssn.predicate_fn(task, node)
                 except Exception:
                     continue
-                # Match allocate's placement test exactly (fits Idle → bind,
-                # else fits Releasing → pipeline); idle+releasing summed
-                # would skip eviction for a task allocate cannot place.
-                if task.init_resreq.less_equal(node.idle) or (
-                    task.init_resreq.less_equal(node.releasing)
-                ):
-                    fits_free = True
+                feasible.append(node)
+
+            # Deliberate divergence from reclaim.go: skip eviction when
+            # free capacity already suffices — allocate, which runs after
+            # reclaim in the default policy, will place this same cycle.
+            # The reference lacks this guard and relies on slow
+            # real-cluster pod deletion to not over-evict; with an
+            # instant substrate it would drain the victim queue far
+            # below its deserved share (its own e2e contract,
+            # test/e2e/queue.go:26-69). The guard must be GANG-aware
+            # and PACKING-aware: "this one task fits" (or "the aggregate
+            # fits") is not enough — if the job still needs k members
+            # for minAvailable and free capacity cannot hold all k
+            # per-node, skipping would deadlock (partial gang
+            # allocations never dispatch, so the same free capacity
+            # re-appears every cycle while reclaim keeps skipping).
+            # Simulate allocate's placement test (fits Idle → bind, else
+            # fits Releasing → pipeline) with first-fit-decreasing over
+            # the feasible nodes; skip eviction only when EVERY needed
+            # gang member places. First-fit may fail where a smarter
+            # packing succeeds — that errs toward evicting, which is the
+            # reference's own behavior and self-corrects next cycle.
+            needed = max(
+                1,
+                job.min_available
+                - job.ready_task_num()
+                - job.waiting_task_num(),
+            )
+            peeked = []
+            while len(peeked) < needed - 1 and not tasks.empty():
+                peeked.append(tasks.pop())
+            for t in peeked:
+                tasks.push(t)
+            gang_reqs = sorted(
+                [task.init_resreq] + [t.init_resreq for t in peeked],
+                key=lambda r: (r.milli_cpu, r.memory),
+                reverse=True,
+            )
+            sim = [
+                (n.idle.clone(), n.releasing.clone()) for n in feasible
+            ]
+            all_fit = True
+            for req in gang_reqs:
+                for idle, releasing in sim:
+                    if req.less_equal(idle):
+                        idle.sub(req)
+                        break
+                    if req.less_equal(releasing):
+                        releasing.sub(req)
+                        break
+                else:
+                    all_fit = False
                     break
-            if fits_free:
+            if all_fit:
                 queues.push(queue)
                 continue
 
             assigned = False
-            for node in get_node_list(ssn.nodes):
-                try:
-                    ssn.predicate_fn(task, node)
-                except Exception:
-                    continue
-
+            for node in feasible:
                 resreq = task.init_resreq.clone()
                 reclaimed = Resource.empty()
 

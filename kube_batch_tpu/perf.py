@@ -196,6 +196,174 @@ def run_density(
     }
 
 
+MULTITENANT_CONF = """
+actions: "reclaim, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def run_multitenant(
+    nodes: int = 100,
+    pods_per_group: int = 10,
+    node_cpu: str = "32",
+    node_memory: str = "128Gi",
+    pods_per_node: int = 110,
+    pod_cpu: str = "1",
+    pod_memory: str = "1Gi",
+    besteffort_pods: int = 20,
+    schedule_period: float = 0.1,
+    kubelet_delay: float = 0.05,
+    timeout: float = 300.0,
+) -> Dict:
+    """BASELINE.json config (5): multi-tenant cluster with backfill and
+    reclaim at kubemark-style scale (hollow kubelets, real scheduler).
+
+    Phase 1: tenant A (weight 1) saturates the cluster with gangs plus a
+    batch of best-effort (zero-request) pods that only backfill can
+    place. Phase 2: tenant B (weight 3) arrives; proportion's deserved
+    shares flip queue A to reclaimable and B's gangs must run via
+    cross-queue reclaim (reference test/e2e queue.go:26 behavior, at
+    perf scale). The artifact reports B's admission latency percentiles
+    and the eviction count."""
+    cluster = InProcessCluster(
+        simulate_kubelet=True, kubelet_delay=kubelet_delay
+    )
+    recorder = PodWatchRecorder(cluster)
+    cache = SchedulerCache(cluster=cluster)
+
+    cluster.create_queue(build_queue("tenant-a", weight=1))
+    cluster.create_queue(build_queue("tenant-b", weight=3))
+    for j in range(nodes):
+        cluster.create_node(build_node(
+            f"hollow-{j}",
+            build_resource_list(
+                cpu=node_cpu, memory=node_memory, pods=pods_per_node
+            ),
+        ))
+
+    # Tenant A: enough gang pods to consume every CPU. minMember is half
+    # the gang — members above minAvailable are reclaimable (gang's
+    # ReclaimableFn protects exactly the minAvailable floor,
+    # gang.go:70-93); a full-gang tenant would be reclaim-proof.
+    from .api.resource_info import parse_quantity
+
+    node_milli = parse_quantity(node_cpu) * 1000
+    pod_milli = parse_quantity(pod_cpu) * 1000
+    pods_a = int(nodes * node_milli // pod_milli)
+    a_keys = []
+    groups_a = max(1, pods_a // pods_per_group)
+    for g in range(groups_a):
+        cluster.create_pod_group(build_pod_group(
+            f"tena-{g}", namespace="perf",
+            min_member=max(1, pods_per_group // 2),
+            queue="tenant-a",
+        ))
+        for i in range(pods_per_group):
+            pod = build_pod(
+                "perf", f"tena-{g}-{i}", "", PodPhase.PENDING,
+                build_resource_list(cpu=pod_cpu, memory=pod_memory),
+                group_name=f"tena-{g}",
+            )
+            cluster.create_pod(pod)
+            a_keys.append(f"perf/{pod.metadata.name}")
+    # Best-effort pods: zero requests, placeable only by backfill.
+    # Explicit minMember=1 groups on tenant-a (a groupless pod would get
+    # a shadow group on the nonexistent 'default' queue).
+    be_keys = []
+    for i in range(besteffort_pods):
+        cluster.create_pod_group(build_pod_group(
+            f"be-{i}", namespace="perf", min_member=1, queue="tenant-a",
+        ))
+        pod = build_pod(
+            "perf", f"be-{i}", "", PodPhase.PENDING,
+            build_resource_list(), group_name=f"be-{i}",
+        )
+        cluster.create_pod(pod)
+        be_keys.append(f"perf/{pod.metadata.name}")
+
+    sched = Scheduler(
+        cache, MULTITENANT_CONF, schedule_period=schedule_period
+    )
+    stop = threading.Event()
+    thread = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    start = time.time()
+    thread.start()
+    deadline = start + timeout / 2
+    while time.time() < deadline and not recorder.all_running(
+        a_keys + be_keys
+    ):
+        time.sleep(0.05)
+
+    # Phase 2: tenant B deserves 3/4 of the cluster; its gangs can only
+    # run by reclaiming tenant A's pods.
+    pods_b = pods_a // 2
+    b_keys = []
+    b_start = time.time()
+    groups_b = max(1, pods_b // pods_per_group)
+    for g in range(groups_b):
+        cluster.create_pod_group(build_pod_group(
+            f"tenb-{g}", namespace="perf", min_member=pods_per_group,
+            queue="tenant-b",
+        ))
+        for i in range(pods_per_group):
+            pod = build_pod(
+                "perf", f"tenb-{g}-{i}", "", PodPhase.PENDING,
+                build_resource_list(cpu=pod_cpu, memory=pod_memory),
+                group_name=f"tenb-{g}",
+            )
+            cluster.create_pod(pod)
+            b_keys.append(f"perf/{pod.metadata.name}")
+
+    deadline = time.time() + timeout / 2
+    while time.time() < deadline and not recorder.all_running(b_keys):
+        time.sleep(0.05)
+    wall = time.time() - start
+    stop.set()
+    thread.join(timeout=10)
+
+    with recorder.lock:
+        b_admission = [
+            (recorder.running[k] - b_start) * 1e3
+            for k in b_keys if k in recorder.running
+        ]
+        a_running = sum(1 for k in a_keys if k in recorder.running)
+        be_running = sum(1 for k in be_keys if k in recorder.running)
+        b_running = sum(1 for k in b_keys if k in recorder.running)
+    evicted = sum(
+        1 for k in a_keys
+        if cluster.get_pod("perf", k.split("/", 1)[1]) is None
+    )
+
+    return {
+        "version": PERF_VERSION,
+        "metric": "multitenant_reclaim",
+        "config": {
+            "nodes": nodes,
+            "tenant_a_pods": pods_a,
+            "tenant_b_pods": pods_b,
+            "besteffort_pods": besteffort_pods,
+            "weights": {"tenant-a": 1, "tenant-b": 3},
+        },
+        "tenant_a_running_initial": a_running,
+        "besteffort_backfilled": be_running,
+        "tenant_b_running": b_running,
+        "tenant_a_evicted": evicted,
+        "wall_seconds": round(wall, 3),
+        "dataItems": [
+            {"label": "tenant_b_admission_ms", **percentiles(b_admission)},
+        ],
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pods", type=int, default=100,
@@ -207,17 +375,39 @@ def main(argv=None):
     ap.add_argument("--kubelet-delay", type=float, default=0.05)
     ap.add_argument("--conf", default=None, help="scheduler policy YAML path")
     ap.add_argument("--out", default=None, help="write perf JSON artifact")
+    ap.add_argument(
+        "--scenario", choices=("density", "multitenant"), default="density",
+        help="density = BASELINE config kubemark density; multitenant = "
+             "BASELINE config (5): two weighted queues, backfill of "
+             "best-effort pods, cross-queue reclaim",
+    )
     args = ap.parse_args(argv)
 
-    artifact = run_density(
-        total_pods=args.pods,
-        nodes=args.nodes,
-        pods_per_group=args.group_size,
-        min_member_frac=args.min_member_frac,
-        schedule_period=args.period,
-        kubelet_delay=args.kubelet_delay,
-        scheduler_conf=args.conf,
-    )
+    if args.scenario == "multitenant":
+        # These density-only knobs would be silently dropped — refuse
+        # instead so results never misrepresent the requested config.
+        if args.conf or args.pods != 100 or args.min_member_frac != 1.0:
+            ap.error(
+                "--pods/--min-member-frac/--conf apply to the density "
+                "scenario only (multitenant sizes tenants from the "
+                "cluster and pins the reclaim policy)"
+            )
+        artifact = run_multitenant(
+            nodes=args.nodes,
+            pods_per_group=args.group_size,
+            schedule_period=args.period,
+            kubelet_delay=args.kubelet_delay,
+        )
+    else:
+        artifact = run_density(
+            total_pods=args.pods,
+            nodes=args.nodes,
+            pods_per_group=args.group_size,
+            min_member_frac=args.min_member_frac,
+            schedule_period=args.period,
+            kubelet_delay=args.kubelet_delay,
+            scheduler_conf=args.conf,
+        )
     line = json.dumps(artifact)
     print(line)
     if args.out:

@@ -121,8 +121,16 @@ class ProportionPlugin(Plugin):
             victims = []
             allocations: Dict[str, Resource] = {}
             for reclaimee in reclaimees:
-                job = ssn.jobs[reclaimee.job]
-                attr = self.queue_attrs[job.queue]
+                job = ssn.jobs.get(reclaimee.job)
+                attr = (
+                    self.queue_attrs.get(job.queue)
+                    if job is not None else None
+                )
+                if attr is None:
+                    # Untracked queue (see _attr_of): proportion has no
+                    # share opinion, so it neither protects nor offers
+                    # the task — raising here would abort reclaim.
+                    continue
                 if job.queue not in allocations:
                     allocations[job.queue] = attr.allocated.clone()
                 allocated = allocations[job.queue]
@@ -151,15 +159,28 @@ class ProportionPlugin(Plugin):
 
         ssn.add_queue_budget_fn(self.name(), queue_budget_fn)
 
+        def _attr_of(task):
+            # A task whose job sits on a queue proportion never tracked
+            # (e.g. a shadow job on a deleted/missing queue — the same
+            # jobs on_session_open skips) has no share bookkeeping; an
+            # event handler raising here would abort the caller's whole
+            # allocate, so skip instead.
+            job = ssn.jobs.get(task.job)
+            if job is None:
+                return None
+            return self.queue_attrs.get(job.queue)
+
         def on_allocate(event):
-            job = ssn.jobs[event.task.job]
-            attr = self.queue_attrs[job.queue]
+            attr = _attr_of(event.task)
+            if attr is None:
+                return
             attr.allocated.add(event.task.resreq)
             self._update_share(attr)
 
         def on_deallocate(event):
-            job = ssn.jobs[event.task.job]
-            attr = self.queue_attrs[job.queue]
+            attr = _attr_of(event.task)
+            if attr is None:
+                return
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
@@ -167,10 +188,11 @@ class ProportionPlugin(Plugin):
             # Fold of on_allocate: aggregate per queue, one share update.
             touched = {}
             for ev in events:
-                job = ssn.jobs[ev.task.job]
-                attr = self.queue_attrs[job.queue]
+                attr = _attr_of(ev.task)
+                if attr is None:
+                    continue
                 attr.allocated.add(ev.task.resreq)
-                touched[job.queue] = attr
+                touched[id(attr)] = attr
             for attr in touched.values():
                 self._update_share(attr)
 

@@ -195,6 +195,31 @@ class TestReclaim:
             assert len(ctx.running_pods("greedy")) == 2
 
 
+class TestGangReclaim:
+    def test_gang_claimant_reclaims_full_quantum(self):
+        """Gang-aware reclaim guard (r3): a claimant gang whose
+        minMember exceeds the already-free capacity must keep reclaiming
+        until the WHOLE quantum fits — 'one task fits free capacity' must
+        not stall eviction (partial gang allocations never dispatch, so
+        that free capacity reappears every cycle)."""
+        with Context(nodes=4, node_cpu="4", node_mem="16Gi",
+                     queues={"qa": 1, "qb": 3}, conf=RECLAIM_CONF) as ctx:
+            # qa: 4 gangs x 4 pods (min 2) fill all 16 CPUs.
+            for g in range(4):
+                ctx.create_and_submit(JobSpec(
+                    name=f"tena-{g}", queue="qa", replicas=4,
+                    min_member=2))
+            for g in range(4):
+                assert ctx.wait_tasks_ready(f"tena-{g}", 4)
+            # qb gang needs 4 CPUs at once; after the first eviction only
+            # 1-2 are free — the guard must keep evicting to the quantum.
+            ctx.create_and_submit(JobSpec(
+                name="tenb", queue="qb", replicas=4, min_member=4))
+            assert ctx.wait_tasks_ready("tenb", 4, timeout=30)
+            ctx.settle()
+            assert len(ctx.running_pods("tenb")) == 4
+
+
 class TestPredicates:
     def test_node_selector(self):
         """'Pod Affinity/NodeSelector' (predicates.go:29): pods only land on
