@@ -176,7 +176,12 @@ class JobInfo:
     # -- task bookkeeping ---------------------------------------------------
 
     def _add_task_index(self, ti: TaskInfo) -> None:
-        self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
+        # Hot path (3 calls per placement): .get + conditional insert
+        # avoids setdefault's throwaway dict allocation per call.
+        idx = self.task_status_index.get(ti.status)
+        if idx is None:
+            idx = self.task_status_index[ti.status] = {}
+        idx[ti.uid] = ti
 
     def _delete_task_index(self, ti: TaskInfo) -> None:
         tasks = self.task_status_index.get(ti.status)

@@ -176,22 +176,27 @@ class NodeInfo:
         sequential apply loop. Shared by Session.allocate_batch and
         SchedulerCache.bind_batch so the fallback policy lives next to
         the accounting it protects."""
-        try:
-            self.add_tasks(tasks)
-            return list(tasks)
-        except Exception:
-            placed: List[TaskInfo] = []
-            for task in tasks:
-                try:
-                    self.add_task(task)
-                except Exception:
-                    logger.exception(
-                        "failed to place task <%s/%s> on node <%s>",
-                        task.namespace, task.name, self.name,
-                    )
-                    continue
-                placed.append(task)
-            return placed
+        if len(tasks) > 1:
+            # Degenerate single-task groups (e.g. a gang spread
+            # one-task-per-node) skip the batch machinery and fall
+            # through to the sequential loop directly.
+            try:
+                self.add_tasks(tasks)
+                return list(tasks)
+            except Exception:
+                pass
+        placed: List[TaskInfo] = []
+        for task in tasks:
+            try:
+                self.add_task(task)
+            except Exception:
+                logger.exception(
+                    "failed to place task <%s/%s> on node <%s>",
+                    task.namespace, task.name, self.name,
+                )
+                continue
+            placed.append(task)
+        return placed
 
     def remove_task(self, ti: TaskInfo) -> None:
         """reference node_info.go:209-235"""
