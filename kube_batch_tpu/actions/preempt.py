@@ -158,27 +158,31 @@ class PreemptAction(Action):
                 if assigned:
                     preemptors.push(preemptor_job)
 
-            # Phase 2: preemption between tasks within a job (preempt.go:137-167).
-            for job in under_request:
-                while True:
-                    tasks = preemptor_tasks.get(job.uid)
-                    if tasks is None or tasks.empty():
-                        break
-                    preemptor = tasks.pop()
-                    stmt = ssn.statement()
-                    assigned = _preempt(
-                        ssn,
-                        stmt,
-                        preemptor,
-                        ssn.nodes,
-                        lambda task, _p=preemptor: (
-                            task.status == TaskStatus.RUNNING
-                            and _p.job == task.job
-                        ),
-                    )
-                    stmt.commit()
-                    if not assigned:
-                        break
+        # Phase 2: preemption between tasks within a job, ONCE after every
+        # queue's phase 1 (preempt.go:137-167 — this loop sits outside the
+        # queue loop in the reference; running it per queue would let
+        # intra-job preemption act on later queues' jobs before their own
+        # inter-job phase).
+        for job in under_request:
+            while True:
+                tasks = preemptor_tasks.get(job.uid)
+                if tasks is None or tasks.empty():
+                    break
+                preemptor = tasks.pop()
+                stmt = ssn.statement()
+                assigned = _preempt(
+                    ssn,
+                    stmt,
+                    preemptor,
+                    ssn.nodes,
+                    lambda task, _p=preemptor: (
+                        task.status == TaskStatus.RUNNING
+                        and _p.job == task.job
+                    ),
+                )
+                stmt.commit()
+                if not assigned:
+                    break
 
 
 register_action(PreemptAction())
