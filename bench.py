@@ -391,6 +391,26 @@ def main():
     headline_solve_s = tpu["solve_s"]
     headline_rounds = tpu["rounds"]
     if jax.devices()[0].platform == "cpu":
+        # The tunneled chip is intermittent; when this run fell back to
+        # CPU, point at the committed on-device evidence so a CPU
+        # artifact doesn't read as "never ran on TPU". Defensive: a
+        # clobbered artifact must not kill the bench after measuring.
+        val_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tpu_validation_r3.json",
+        )
+        try:
+            with open(val_path) as f:
+                val = json.load(f)
+            if isinstance(val, dict):
+                extra["last_tpu_validation"] = {
+                    "headline_ms": val.get("headline_ms"),
+                    "vs_baseline": val.get("vs_baseline"),
+                    "recorded": val.get("started"),
+                    "artifact": os.path.basename(val_path),
+                }
+        except (OSError, ValueError):
+            pass
         # No accelerator: the framework's production path is the native
         # masked loop (allocate_tpu routes there), so THAT is the honest
         # headline; the batched-kernel CPU time is kept as a side metric.
@@ -403,7 +423,7 @@ def main():
             headline_rounds = 1  # sequential loop, not the JAX rounds
             extra["jax_solve_cpu_ms"] = round(solve_ms, 1)
             extra["jax_solver_rounds"] = tpu["rounds"]
-            extra["solver_path"] = "native-masked-cpu-fallback"
+            extra["solver_path"] = "native-masked-cpu-fallback" 
             # Speedup must compare against the value actually reported:
             # native baseline when measured, else the extrapolated greedy
             # vs the headline (NOT the JAX solve the headline replaced).

@@ -36,3 +36,18 @@ def test_density_small_cluster_runs_all_pods():
     assert e2e["Perc100"] >= e2e["Perc50"] > 0
     # Artifact is JSON-serializable (driver writes it to disk).
     json.dumps(artifact)
+
+
+def test_multitenant_small_cluster_reclaims_and_backfills():
+    """CI-size run of the BASELINE config (5) scenario: tenant B fully
+    admitted via reclaim, best-effort pods backfilled, evictions > 0."""
+    from kube_batch_tpu.perf import run_multitenant
+
+    art = run_multitenant(
+        nodes=4, pods_per_group=4, node_cpu="4", pod_cpu="1",
+        besteffort_pods=2, schedule_period=0.05, timeout=60,
+    )
+    assert art["tenant_b_running"] == art["config"]["tenant_b_pods"]
+    assert art["besteffort_backfilled"] == 2
+    assert art["tenant_a_evicted"] > 0
+    assert art["dataItems"][0]["Perc100"] > 0
