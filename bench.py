@@ -423,7 +423,7 @@ def main():
             headline_rounds = 1  # sequential loop, not the JAX rounds
             extra["jax_solve_cpu_ms"] = round(solve_ms, 1)
             extra["jax_solver_rounds"] = tpu["rounds"]
-            extra["solver_path"] = "native-masked-cpu-fallback" 
+            extra["solver_path"] = "native-masked-cpu-fallback"
             # Speedup must compare against the value actually reported:
             # native baseline when measured, else the extrapolated greedy
             # vs the headline (NOT the JAX solve the headline replaced).

@@ -103,3 +103,25 @@ def test_solver_invariants_random_instances(seed):
         check_invariants(
             n_assigned, task_req, feas, idle, valid, max_tasks, "native"
         )
+
+
+# Staged solver at a forced-small tail bucket: the head/tail compaction
+# machinery (top-k compaction, subset-local job blocking, multi-stage
+# outer loop) must satisfy the same invariants and place the same number
+# of tasks as the full-width solver on every instance.
+from kube_batch_tpu.solver import solve_staged_jit
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.integers(min_value=0, max_value=10_000))
+def test_staged_solver_matches_full_on_random_instances(seed):
+    inputs, task_req, feas, idle, valid, max_tasks = build(seed)
+    full = np.asarray(solve_jit(inputs).assigned)
+    staged = np.asarray(solve_staged_jit(inputs, tail_bucket=16).assigned)
+    check_invariants(
+        staged, task_req, feas, idle, valid, max_tasks, "staged"
+    )
+    assert (staged >= 0).sum() == (full >= 0).sum(), (
+        "staged and full solvers placed different counts",
+        int((staged >= 0).sum()), int((full >= 0).sum()),
+    )
