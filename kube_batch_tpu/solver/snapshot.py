@@ -156,11 +156,22 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
     from .kernels import PackedInputs
     from .masks import combine_masks, combine_score_rows
 
-    layout = ResourceLayout.for_session(ssn)
-
     nodes = [n for n in ssn.nodes.values() if n.ready()]
     if not nodes:
         return None, None
+
+    job_pool = include_jobs if include_jobs is not None else ssn.jobs.values()
+
+    # Idle-cycle fast path: the common 1 Hz no-work case must not pay
+    # the O(all tasks) layout scan below — bail before it when no job
+    # has any pending task at all.
+    if not any(
+        job.task_status_index.get(TaskStatus.PENDING)
+        for job in job_pool
+    ):
+        return None, None
+
+    layout = ResourceLayout.for_session(ssn)
 
     # --- ordered task list: queue rank → job rank → task rank -------------
     queues = [q for q in ssn.queues.values()]
@@ -168,7 +179,6 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
     queue_index = {q.uid: i for i, q in enumerate(queue_order)}
 
     jobs_by_queue: Dict[str, List[JobInfo]] = {}
-    job_pool = include_jobs if include_jobs is not None else ssn.jobs.values()
     for job in job_pool:
         if job.queue not in ssn.queues:
             continue
