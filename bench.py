@@ -259,10 +259,13 @@ def bench_tpu(cfg, seed=0, repeats=3):
         "rounds": rounds,
         "work": n_tasks * n_nodes,
         "inputs": inputs,
+        # Every task is still Pending (the solve was never applied):
+        # bench_cycle reuses this cluster instead of rebuilding it.
+        "cache": cache,
     }
 
 
-def bench_cycle(cfg, seed=0):
+def bench_cycle(cfg, seed=0, cache=None):
     """Full scheduling cycles through the production allocate_tpu action —
     the number BASELINE.md's <100 ms target is really about (the reference
     hot path is the whole runOnce, scheduler.go:88-103, not the inner
@@ -282,7 +285,25 @@ def bench_cycle(cfg, seed=0):
     from kube_batch_tpu.actions import allocate_tpu as _atpu
 
     n_tasks, n_nodes, n_queues, n_groups = CONFIGS[cfg]
-    cache = build_cluster(n_tasks, n_nodes, n_queues, n_groups, seed)
+    if cache is None:
+        # Callers that already built this config's cluster (bench_tpu
+        # leaves every task pending) pass it in — a second 50k build
+        # costs ~2 min of the driver's deadline.
+        cache = build_cluster(n_tasks, n_nodes, n_queues, n_groups, seed)
+    else:
+        # The passed cache saw a prior session open + tensorize, so the
+        # COW pool and the per-pod tensorize caches are warm; a real
+        # pending burst arrives with fresh pods. Re-cold BOTH so the
+        # cold cycle measures burst-arrival cost: dirty every job
+        # (forces re-clone; nodes legitimately stay reused — pod
+        # arrivals do not touch them) and drop the per-pod predicate
+        # caches.
+        for job in cache.jobs.values():
+            job._ver += 1
+            for task in job.tasks.values():
+                for attr in ("_predicate_sig", "_private_pred"):
+                    if hasattr(task.pod, attr):
+                        delattr(task.pod, attr)
     action, _ = get_action("allocate_tpu")
 
     def one_cycle():
@@ -437,7 +458,7 @@ def main():
     # Guarded: a crash/hang here must not lose the already-measured headline
     # (round-1 lesson — a bench that dies records nothing).
     try:
-        cycle = bench_cycle(headline_cfg)
+        cycle = bench_cycle(headline_cfg, cache=tpu["cache"])
     except Exception as exc:  # pragma: no cover - defensive
         cycle = {"error": f"{type(exc).__name__}: {exc}"}
 

@@ -110,9 +110,13 @@ def main():
     if not args.skip_bench:
         report["bench"] = {}
         for cfg in ("small", "medium", "large"):
-            report["bench"][cfg] = run_bench(cfg)
+            # bench.py now measures full production cycles too; the
+            # large config needs more runway than the old solve-only run.
+            report["bench"][cfg] = run_bench(
+                cfg, timeout=1500 if cfg == "large" else 900
+            )
         report["bench_pallas_large"] = run_bench(
-            "large", env_extra={"KBT_PALLAS": "1"}
+            "large", env_extra={"KBT_PALLAS": "1"}, timeout=1500
         )
     report["pallas"] = run_pallas_parity()
 
