@@ -297,13 +297,12 @@ def bench_cycle(cfg, seed=0, cache=None):
         # cold cycle measures burst-arrival cost: dirty every job
         # (forces re-clone; nodes legitimately stay reused — pod
         # arrivals do not touch them) and drop the per-pod predicate
-        # caches.
+        # caches via the plugin-owned helper (the attr list lives there).
+        from kube_batch_tpu.plugins.predicates import clear_pod_caches
+
         for job in cache.jobs.values():
             job._ver += 1
-            for task in job.tasks.values():
-                for attr in ("_predicate_sig", "_private_pred"):
-                    if hasattr(task.pod, attr):
-                        delattr(task.pod, attr)
+            clear_pod_caches(t.pod for t in job.tasks.values())
     action, _ = get_action("allocate_tpu")
 
     def one_cycle():

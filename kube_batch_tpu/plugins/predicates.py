@@ -37,6 +37,21 @@ from .util import (
     match_node_selector_terms,
 )
 
+# Per-pod memo attributes this plugin stamps onto (immutable) pod specs
+# for tensorize speed. Anything that needs to re-cold these caches (the
+# bench's burst simulation) must go through clear_pod_caches so the attr
+# list lives in exactly one place.
+POD_CACHE_ATTRS = ("_predicate_sig", "_private_pred")
+
+
+def clear_pod_caches(pods) -> None:
+    """Drop this plugin's per-pod memos (see POD_CACHE_ATTRS)."""
+    for pod in pods:
+        for attr in POD_CACHE_ATTRS:
+            if hasattr(pod, attr):
+                delattr(pod, attr)
+
+
 # Argument keys (reference predicates.go:75-95).
 MEMORY_PRESSURE_ENABLE = "predicate.MemoryPressureEnable"
 DISK_PRESSURE_ENABLE = "predicate.DiskPressureEnable"

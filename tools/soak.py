@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Endurance soak: the live scheduler loop under continuous churn.
+
+Runs the real Scheduler (cache watch ingest, COW snapshots, solver or
+greedy policy, async binds) against the in-process cluster while a churn
+driver continuously:
+
+- submits new gangs (random sizes/requests),
+- deletes completed gangs (freeing capacity),
+- flaps nodes (delete + re-add, exercising delete reconciliation and
+  NotReady handling).
+
+At the end it asserts the invariants a long-lived scheduler must hold:
+
+- the cache mirror's per-node accounting equals the cluster's actual
+  bound pods (no phantom capacity, no leaks),
+- every surviving gang is either fully pending or >= minMember running
+  (no stuck partial gangs),
+- the scheduling loop never died (cycles kept incrementing).
+
+Usage: python tools/soak.py [--minutes 5] [--nodes 50] [--period 0.2]
+Exit 0 on a clean soak; 1 with diagnostics otherwise.
+"""
+
+import argparse
+import os
+import random
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kube_batch_tpu.api import PodPhase, build_resource_list  # noqa: E402
+from kube_batch_tpu.cache import SchedulerCache  # noqa: E402
+from kube_batch_tpu.cluster import InProcessCluster  # noqa: E402
+from kube_batch_tpu.scheduler import Scheduler  # noqa: E402
+from kube_batch_tpu.metrics import metrics as _metrics  # noqa: E402
+from kube_batch_tpu.utils.test_utils import (  # noqa: E402
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=5.0)
+    ap.add_argument("--nodes", type=int, default=50)
+    ap.add_argument("--period", type=float, default=0.2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--conf", default=None,
+                    help="scheduler policy YAML path (default policy if unset)")
+    args = ap.parse_args()
+    rng = random.Random(args.seed)
+
+    cluster = InProcessCluster(simulate_kubelet=True, kubelet_delay=0.02)
+    cluster.create_queue(build_queue("default", weight=1))
+    for j in range(args.nodes):
+        cluster.create_node(build_node(
+            f"n{j}", build_resource_list(cpu="16", memory="64Gi", pods=110)
+        ))
+    cache = SchedulerCache(cluster=cluster)
+    sched = Scheduler(cache, args.conf, schedule_period=args.period)
+    stop = threading.Event()
+    loop = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    loop.start()
+
+    deadline = time.time() + args.minutes * 60
+    gang_id = 0
+    live_gangs = []  # (name, size, min_member, created_at)
+    submitted = deleted = flaps = 0
+    while time.time() < deadline:
+        action = rng.random()
+        if action < 0.55 or len(live_gangs) < 4:
+            size = rng.randint(2, 8)
+            name = f"soak-{gang_id}"
+            gang_id += 1
+            cluster.create_pod_group(build_pod_group(
+                name, namespace="soak",
+                min_member=rng.randint(1, size), queue="default",
+            ))
+            for i in range(size):
+                cluster.create_pod(build_pod(
+                    "soak", f"{name}-{i}", "", PodPhase.PENDING,
+                    build_resource_list(
+                        cpu=f"{rng.choice([250, 500, 1000, 2000])}m",
+                        memory=f"{rng.choice([256, 512, 1024])}Mi",
+                    ),
+                    group_name=name,
+                ))
+            live_gangs.append(name)
+            submitted += 1
+        elif action < 0.9 and live_gangs:
+            # Gang completes: delete its pods + group.
+            name = live_gangs.pop(rng.randrange(len(live_gangs)))
+            for pod in list(cluster.list_objects("Pod")):
+                if pod.namespace == "soak" and pod.name.startswith(name + "-"):
+                    cluster.delete_pod(pod)
+            for pg in list(cluster.list_objects("PodGroup")):
+                if pg.name == name:
+                    cluster.delete("PodGroup", pg)
+            deleted += 1
+        else:
+            # Node flap: the node dies and every gang with a member on it
+            # is killed WHOLE (the controller-restarts-the-gang model) —
+            # otherwise flap-decimated gangs would read as scheduler
+            # "partial gang" violations that the scheduler never caused.
+            j = rng.randrange(args.nodes)
+            for node in list(cluster.list_objects("Node")):
+                if node.name == f"n{j}":
+                    dead_gangs = set()
+                    for pod in list(cluster.list_objects("Pod")):
+                        if pod.spec.node_name == node.name:
+                            dead_gangs.add(pod.name.rsplit("-", 1)[0])
+                    for pod in list(cluster.list_objects("Pod")):
+                        if pod.name.rsplit("-", 1)[0] in dead_gangs:
+                            cluster.delete_pod(pod)
+                    for pg in list(cluster.list_objects("PodGroup")):
+                        if pg.name in dead_gangs:
+                            cluster.delete("PodGroup", pg)
+                    live_gangs = [
+                        g for g in live_gangs if g not in dead_gangs
+                    ]
+                    cluster.delete("Node", node)
+                    break
+            time.sleep(0.05)
+            cluster.create_node(build_node(
+                f"n{j}",
+                build_resource_list(cpu="16", memory="64Gi", pods=110),
+            ))
+            flaps += 1
+        time.sleep(rng.uniform(0.02, 0.15))
+
+    # Quiesce: stop churn, give the loop a few cycles to settle.
+    time.sleep(max(2.0, 6 * args.period))
+    stop.set()
+    loop.join(timeout=10)
+    cache.wait_for_side_effects(timeout=30)
+    time.sleep(0.5)
+
+    failures = []
+
+    # Invariant 1: mirror accounting == cluster truth.
+    pods = [p for p in cluster.list_objects("Pod")]
+    truth = {}
+    for p in pods:
+        if p.spec.node_name and p.status.phase in ("Running", "Pending"):
+            r = truth.setdefault(p.spec.node_name, [0.0, 0])
+            for c in p.spec.containers:
+                cpu = str((c.requests or {}).get("cpu", "0"))
+                r[0] += float(cpu[:-1]) if cpu.endswith("m") \
+                    else float(cpu) * 1000
+            r[1] += 1
+    with cache.mutex:
+        for name, node in cache.nodes.items():
+            want_cpu, want_n = truth.get(name, [0.0, 0])
+            if abs(node.used.milli_cpu - want_cpu) > 10:
+                failures.append(
+                    f"node {name}: mirror used {node.used.milli_cpu}m != "
+                    f"cluster truth {want_cpu}m"
+                )
+            if len(node.tasks) != want_n:
+                failures.append(
+                    f"node {name}: mirror holds {len(node.tasks)} tasks, "
+                    f"cluster has {want_n} bound pods"
+                )
+
+    # Invariant 2: no stuck partial gangs (running < minMember while
+    # some of the gang runs).
+    by_gang = {}
+    for p in pods:
+        if p.namespace != "soak":
+            continue
+        gang = p.name.rsplit("-", 1)[0]
+        by_gang.setdefault(gang, []).append(p)
+    pgs = {pg.name: pg for pg in cluster.list_objects("PodGroup")}
+    for gang, members in by_gang.items():
+        pg = pgs.get(gang)
+        if pg is None:
+            continue
+        running = sum(1 for p in members if p.status.phase == "Running")
+        if 0 < running < pg.spec.min_member:
+            failures.append(
+                f"gang {gang}: {running} running < minMember "
+                f"{pg.spec.min_member} (stuck partial gang)"
+            )
+
+    # Invariant 3: the loop kept scheduling.
+    cycles = _metrics.e2e_scheduling_latency.count()
+    if cycles < (args.minutes * 60 / args.period) * 0.5:
+        failures.append(f"loop starved: only {cycles} cycles ran")
+
+    print(
+        f"soak: {args.minutes} min, {submitted} gangs submitted, "
+        f"{deleted} completed, {flaps} node flaps, {cycles} cycles, "
+        f"{len(pods)} pods at end"
+    )
+    if failures:
+        print("FAIL:")
+        for f in failures:
+            print(" -", f)
+        return 1
+    print("PASS: mirror consistent, no stuck gangs, loop healthy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
