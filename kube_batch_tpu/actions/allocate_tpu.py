@@ -92,9 +92,12 @@ class AllocateTpuAction(Action):
         return "allocate_tpu"
 
     def execute(self, ssn) -> None:
+        # Clear BEFORE tensorize: if it raises, readers (bench cycle
+        # block, metrics) must see an empty dict, not the previous
+        # cycle's timings attributed to the failed cycle.
+        last_stats.clear()
         t0 = time.perf_counter()
         inputs, ctx = tensorize(ssn)
-        last_stats.clear()
         _record_phase("tensorize", (time.perf_counter() - t0) * 1e3)
         if inputs is None:
             return

@@ -23,6 +23,60 @@ class ReclaimAction(Action):
     def name(self) -> str:
         return "reclaim"
 
+    @staticmethod
+    def _sim_gang_fits(ssn, claimant, peeked, claimant_feasible):
+        """First-fit-decreasing placement sim for the skip-eviction guard.
+        Only sound for gangs WITHOUT member-vs-member constraints (caller
+        gates on that): each member's predicate verdict is then a pure
+        function of its spec's constraint fields against current node
+        state, so members with equal constraint specs share one feasible
+        set (homogeneous gangs — the common case — cost one predicate
+        pass total)."""
+        feas_memo = [(claimant.pod.spec, claimant_feasible)]
+
+        def feasible_for(member):
+            spec = member.pod.spec
+            for seen_spec, nodes in feas_memo:
+                if (
+                    spec.node_selector == seen_spec.node_selector
+                    and spec.affinity == seen_spec.affinity
+                    and spec.tolerations == seen_spec.tolerations
+                ):
+                    return nodes
+            nodes = []
+            for node in get_node_list(ssn.nodes):
+                try:
+                    ssn.predicate_fn(member, node)
+                except Exception:
+                    continue
+                nodes.append(node)
+            feas_memo.append((spec, nodes))
+            return nodes
+
+        members = sorted(
+            [claimant] + peeked,
+            key=lambda t: (t.init_resreq.milli_cpu, t.init_resreq.memory),
+            reverse=True,
+        )
+        sim = {}  # node name -> (idle, releasing) mutable copies
+        for member in members:
+            req = member.init_resreq
+            for node in feasible_for(member):
+                if node.name not in sim:
+                    sim[node.name] = (
+                        node.idle.clone(), node.releasing.clone(),
+                    )
+                idle, releasing = sim[node.name]
+                if req.less_equal(idle):
+                    idle.sub(req)
+                    break
+                if req.less_equal(releasing):
+                    releasing.sub(req)
+                    break
+            else:
+                return False
+        return True
+
     def execute(self, ssn) -> None:
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_map = {}
@@ -103,26 +157,35 @@ class ReclaimAction(Action):
                 peeked.append(tasks.pop())
             for t in peeked:
                 tasks.push(t)
-            gang_reqs = sorted(
-                [task.init_resreq] + [t.init_resreq for t in peeked],
-                key=lambda r: (r.milli_cpu, r.memory),
-                reverse=True,
-            )
-            sim = [
-                (n.idle.clone(), n.releasing.clone()) for n in feasible
-            ]
-            all_fit = True
-            for req in gang_reqs:
-                for idle, releasing in sim:
-                    if req.less_equal(idle):
-                        idle.sub(req)
-                        break
-                    if req.less_equal(releasing):
-                        releasing.sub(req)
-                        break
-                else:
-                    all_fit = False
-                    break
+            # Each member places only onto nodes ITS OWN predicates
+            # accept — a heterogeneous gang (per-member selectors/
+            # affinity/ports) must not be simulated onto nodes some
+            # members cannot use, or the skip guard under-evicts every
+            # cycle (the exact livelock it exists to prevent).
+            #
+            # The sim evaluates predicates against CURRENT node state
+            # only; it cannot model member-vs-member interaction (two
+            # members claiming the same host port, or inter-pod
+            # (anti-)affinity among the gang itself — whose verdict also
+            # depends on each pod's own labels, breaking the spec-keyed
+            # memo below). When any member declares such a constraint,
+            # skip the guard entirely and take the eviction path: erring
+            # toward evicting is the reference's own behavior and
+            # self-corrects next cycle, while erring toward skipping is
+            # the livelock.
+            def interacts(member):
+                spec = member.pod.spec
+                if any(c.ports for c in spec.containers):
+                    return True
+                aff = spec.affinity
+                return aff is not None and bool(
+                    aff.pod_affinity or aff.pod_anti_affinity
+                )
+
+            if any(interacts(m) for m in [task] + peeked):
+                all_fit = False
+            else:
+                all_fit = self._sim_gang_fits(ssn, task, peeked, feasible)
             if all_fit:
                 queues.push(queue)
                 continue

@@ -395,9 +395,12 @@ class SchedulerCache(Cache, EventHandlersMixin):
     def _bind_bookkeeping(self, task_info: TaskInfo, hostname: str,
                           add_to_node: bool = True):
         """Under-mutex half of bind: validate, move to Binding, and (by
-        default) account on the node. Returns the STORED task. Caller
-        must hold self.mutex. ``add_to_node=False`` defers the node
-        accounting to the caller (bind_batch groups it per node)."""
+        default) account on the node. Returns ``(job, task, prior)``
+        where ``task`` is the STORED task and ``prior`` its
+        (status, node_name) before the move — what a caller must restore
+        to revert a bind the node later rejects. Caller must hold
+        self.mutex. ``add_to_node=False`` defers the node accounting to
+        the caller (bind_batch groups it per node)."""
         job, task = self._find_job_and_task(task_info)
         node = self.nodes.get(hostname)
         if node is None:
@@ -410,11 +413,12 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 f"failed to bind Task {task.uid}: status is "
                 f"{task.status.name}, expected Pending/Allocated"
             )
+        prior = (task.status, task.node_name)
         job.update_task_status(task, TaskStatus.BINDING)
         task.node_name = hostname
         if add_to_node:
             node.add_task(task)
-        return task
+        return job, task, prior
 
     def _bind_side_effect(self, pod, hostname, task_snapshot) -> None:
         """Async half of bind. The volume bind wait (up to the reference's
@@ -443,7 +447,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
     def bind(self, task_info: TaskInfo, hostname: str) -> None:
         """reference cache.go:480-522"""
         with self.mutex:
-            task = self._bind_bookkeeping(task_info, hostname)
+            _, task, _ = self._bind_bookkeeping(task_info, hostname)
             pod, task_snapshot = task.pod, task.clone()
 
         if self.binder is not None:
@@ -471,13 +475,16 @@ class SchedulerCache(Cache, EventHandlersMixin):
         slow_binds = []  # volume wait possible: isolate per task
         bound = []
         with self.mutex:
-            staged: Dict[str, list] = {}  # hostname -> [(ti, stored)]
+            # hostname -> [(ti, stored, prior status/node for revert)]
+            staged: Dict[str, list] = {}
             for ti in task_infos:
                 try:
-                    stored = self._bind_bookkeeping(
+                    job, stored, prior = self._bind_bookkeeping(
                         ti, ti.node_name, add_to_node=False
                     )
-                    staged.setdefault(ti.node_name, []).append((ti, stored))
+                    staged.setdefault(ti.node_name, []).append(
+                        (ti, stored, job, prior)
+                    )
                 except Exception:
                     logger.exception(
                         "failed to bind task %s/%s", ti.namespace, ti.name
@@ -500,12 +507,35 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 node = self.nodes[hostname]
                 ok = {
                     id(s) for s in node.add_tasks_with_fallback(
-                        [stored for _, stored in items]
+                        [stored for _, stored, _, _ in items]
                     )
                 }
-                for ti, stored in items:
+                for ti, stored, job, prior in items:
                     if id(stored) in ok:
                         accept(ti, stored, hostname)
+                    else:
+                        # The per-task bind() path surfaces a node
+                        # rejection to its caller by raising; here the
+                        # caller is gone by side-effect time, so a
+                        # silently dropped task would sit in BINDING with
+                        # node_name set and no resync until an external
+                        # pod event. Revert the staged bookkeeping so the
+                        # task is schedulable again next cycle.
+                        prior_status, prior_node = prior
+                        try:
+                            job.update_task_status(stored, prior_status)
+                            stored.node_name = prior_node
+                        except Exception:
+                            logger.exception(
+                                "failed to revert rejected bind %s/%s; "
+                                "resyncing", ti.namespace, ti.name,
+                            )
+                            self._resync_task(stored.clone())
+                        logger.warning(
+                            "node %s rejected staged bind of %s/%s; "
+                            "reverted to %s", hostname, ti.namespace,
+                            ti.name, prior_status.name,
+                        )
 
         if self.binder is not None:
             def _do_binds(chunk):

@@ -242,6 +242,63 @@ class TestReclaim:
         assert len(evicts) == 1
         assert evicts[0].startswith("ns/pg1-p")
 
+    def test_heterogeneous_gang_sim_respects_member_predicates(self):
+        # The skip-eviction guard simulates the CLAIMANT's whole gang onto
+        # free capacity. With per-member node selectors, a node only the
+        # claimant can use must not count for a constrained member —
+        # otherwise reclaim skips every cycle while allocate can never
+        # place the full gang (under-eviction livelock).
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=1))
+        c.add_queue(build_queue("q2", weight=1))
+        # n1 (zone=a) fully used by q1's running job; n2 (zone=b) free.
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi"),
+                              labels={"zone": "a"}))
+        c.add_node(build_node("n2", build_resource_list(cpu="4", memory="8Gi"),
+                              labels={"zone": "b"}))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=1,
+                                        queue="q1"))
+        for i in range(2):
+            c.add_pod(build_pod("ns", f"pg1-p{i}", "n1", PodPhase.RUNNING,
+                                req(), group_name="pg1"))
+        # q2's starving gang: claimant is unconstrained (fits free n2),
+        # but the second member is pinned to zone=a, where nothing is
+        # idle. Free capacity does NOT suffice for the gang → must evict.
+        c.add_pod_group(build_pod_group("pg2", namespace="ns", min_member=2,
+                                        queue="q2"))
+        c.add_pod(build_pod("ns", "pg2-p0", "", PodPhase.PENDING, req(),
+                            group_name="pg2"))
+        c.add_pod(build_pod("ns", "pg2-p1", "", PodPhase.PENDING, req(),
+                            group_name="pg2", selector={"zone": "a"}))
+
+        run_action(c, "reclaim")
+        evicts = drain(c.evictor.channel, 1)
+        assert len(evicts) == 1
+        assert evicts[0].startswith("ns/pg1-p")
+
+    def test_homogeneous_gang_still_skips_when_free_capacity_fits(self):
+        # Counterpart: identical specs share one predicate pass and the
+        # deliberate skip-eviction divergence still holds — free capacity
+        # covers the whole gang, so nothing is evicted.
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=1))
+        c.add_queue(build_queue("q2", weight=1))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+        c.add_node(build_node("n2", build_resource_list(cpu="4", memory="8Gi")))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=1,
+                                        queue="q1"))
+        for i in range(2):
+            c.add_pod(build_pod("ns", f"pg1-p{i}", "n1", PodPhase.RUNNING,
+                                req(), group_name="pg1"))
+        c.add_pod_group(build_pod_group("pg2", namespace="ns", min_member=2,
+                                        queue="q2"))
+        for i in range(2):
+            c.add_pod(build_pod("ns", f"pg2-p{i}", "", PodPhase.PENDING,
+                                req(), group_name="pg2"))
+
+        run_action(c, "reclaim")
+        assert drain(c.evictor.channel, 1, timeout=0.3) == []
+
 
 class TestStatementRollback:
     def test_discard_restores_state(self):

@@ -292,6 +292,41 @@ class TestSideEffects:
         key = c.evictor.channel.get(timeout=3)
         assert key == "ns/p1"
 
+    def test_bind_batch_reverts_node_rejected_tasks(self):
+        # A staged task the node's accounting rejects must not be left
+        # wedged in BINDING with node_name set and no resync — it reverts
+        # to its prior status so the next cycle can schedule it again.
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list(cpu="1", memory="1Gi")))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=2))
+        pods = [
+            build_pod("ns", f"p{i}", "", PodPhase.PENDING, req(),
+                      group_name="pg1")
+            for i in range(2)
+        ]
+        for p in pods:
+            c.add_pod(p)
+        tasks = [c.jobs["ns/pg1"].tasks[p.metadata.uid] for p in pods]
+        # Session-side clones carry the solver's placement; the cache's
+        # stored tasks still have node_name="" (the prior state a revert
+        # must restore).
+        infos = [t.clone() for t in tasks]
+        for info in infos:
+            info.node_name = "n1"  # both target n1; only one cpu fits
+            info.volume_ready = True
+
+        bound = c.bind_batch(infos)
+        assert len(bound) == 1
+        assert {t.status for t in tasks} == {
+            TaskStatus.BINDING, TaskStatus.PENDING
+        }
+        rejected = next(t for t in tasks if t.status == TaskStatus.PENDING)
+        assert rejected.node_name == ""
+        accepted = next(t for t in tasks if t.status == TaskStatus.BINDING)
+        assert c.nodes["n1"].used.milli_cpu == 1000
+        key = c.binder.channel.get(timeout=3)
+        assert key == f"ns/{accepted.name}"
+
 
 class TestSnapshotPool:
     """COW snapshot pool: unchanged objects are reused across consecutive

@@ -54,6 +54,82 @@ def run_bench(config, env_extra=None, timeout=900):
         return {"error": proc.stderr[-1000:], "rc": proc.returncode}
 
 
+def run_null_dispatch(timeout=300):
+    """Tunnel overhead in isolation: a trivial jitted call moves ~no data
+    and does ~no compute, so its steady-state dispatch+fetch wall time IS
+    the fixed per-call tunnel cost. Reported separately from the headline
+    so the on-device compute share is measured, not inferred (VERDICT r3
+    'README provenance' finding)."""
+    code = """
+import json, time
+import jax, jax.numpy as jnp
+
+f = jax.jit(lambda x: x + 1)
+x = jnp.zeros((8,), jnp.int32)
+for _ in range(2):  # compile + executable-upload warmups
+    jax.block_until_ready(f(x))
+reps = []
+for _ in range(20):
+    t0 = time.perf_counter()
+    jax.block_until_ready(f(x))
+    reps.append((time.perf_counter() - t0) * 1e3)
+reps.sort()
+print(json.dumps({
+    "null_dispatch_ms_median": round(reps[len(reps) // 2], 2),
+    "null_dispatch_ms_min": round(reps[0], 2),
+    "null_dispatch_ms_max": round(reps[-1], 2),
+    "platform": jax.devices()[0].platform,
+}))
+"""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout}s"}
+    line = (proc.stdout.strip().splitlines() or [""])[-1]
+    try:
+        return json.loads(line)
+    except ValueError:
+        return {"error": proc.stderr[-1000:], "rc": proc.returncode}
+
+
+def run_traced_bench(trace_dir, timeout=1800):
+    """Headline bench with a jax.profiler trace captured into trace_dir,
+    then compressed to a committable artifact (traces/tpu_trace_r4.tar.gz)
+    so the device-compute decomposition is backed by evidence in-repo."""
+    import shutil
+    import tarfile
+
+    if os.path.isdir(trace_dir):
+        shutil.rmtree(trace_dir)
+    result = run_bench("large", env_extra=None, timeout=timeout)
+    # run_bench doesn't pass --profile; trace in a dedicated run so a
+    # profiler failure can't lose the bench number.
+    try:
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--config", "large",
+             "--profile", trace_dir],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        )
+        if proc.returncode == 0 and os.path.isdir(trace_dir):
+            out = os.path.join(REPO, "traces")
+            os.makedirs(out, exist_ok=True)
+            tar_path = os.path.join(out, "tpu_trace_r4.tar.gz")
+            with tarfile.open(tar_path, "w:gz") as tar:
+                tar.add(trace_dir, arcname="tpu_trace_r4")
+            result["trace_artifact"] = os.path.relpath(tar_path, REPO)
+            # Only the tarball is meant for the repo; leaving the raw
+            # profile next to it invites `git add traces/` to stage it.
+            shutil.rmtree(trace_dir, ignore_errors=True)
+        else:
+            result["trace_error"] = proc.stderr[-800:]
+    except subprocess.TimeoutExpired:
+        result["trace_error"] = f"trace run timeout after {timeout}s"
+    return result
+
+
 def run_pallas_parity(timeout=600):
     """Compiled (non-interpret) pallas_bid parity on the device."""
     code = """
@@ -107,18 +183,31 @@ def main():
             json.dump(report, f, indent=2)
         return 1
 
+    # Null dispatch FIRST: it is the cheapest run and the tunnel dies
+    # unpredictably — the decomposition denominator must not be the
+    # casualty of a mid-runbook wedge.
+    report["null_dispatch"] = run_null_dispatch()
+
     if not args.skip_bench:
         report["bench"] = {}
-        for cfg in ("small", "medium", "large"):
-            # bench.py now measures full production cycles too; the
-            # large config needs more runway than the old solve-only run.
-            report["bench"][cfg] = run_bench(
-                cfg, timeout=1500 if cfg == "large" else 900
-            )
+        for cfg in ("small", "medium"):
+            report["bench"][cfg] = run_bench(cfg, timeout=900)
+        # Headline large run doubles as the profiler-trace capture; the
+        # compressed trace lands in traces/ as a committable artifact.
+        report["bench"]["large"] = run_traced_bench(
+            os.path.join(REPO, "traces", "r4_profile"), timeout=1800
+        )
         report["bench_pallas_large"] = run_bench(
             "large", env_extra={"KBT_PALLAS": "1"}, timeout=1500
         )
     report["pallas"] = run_pallas_parity()
+
+    null_ms = (report.get("null_dispatch") or {}).get(
+        "null_dispatch_ms_median"
+    )
+    head = (report.get("bench", {}) or {}).get("large", {}).get("value")
+    if isinstance(null_ms, (int, float)) and isinstance(head, (int, float)):
+        report["device_compute_est_ms"] = round(head - null_ms, 1)
 
     large = (report.get("bench", {}) or {}).get("large", {})
     report["headline_ms"] = large.get("value")
@@ -128,10 +217,23 @@ def main():
         and large["value"] < 100
         and large.get("device") == "tpu"
     )
+    # Secondary bar (VERDICT r4 item 1): device compute <100 ms with the
+    # tunnel's fixed dispatch cost measured separately, not inferred.
+    report["device_target_met"] = bool(
+        isinstance(report.get("device_compute_est_ms"), (int, float))
+        and report["device_compute_est_ms"] < 100
+        and large.get("device") == "tpu"
+    )
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report, indent=2))
-    return 0
+    # rc contract (tpu_watch.sh keys on it): 0 only when the headline
+    # bench genuinely ran on the TPU. A tunnel that answered the probe
+    # but died mid-runbook must read as failure so the watcher keeps
+    # watching instead of retiring on a useless report.
+    if args.skip_bench:
+        return 0
+    return 0 if large.get("device") == "tpu" else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# TPU tunnel watcher: probe cheaply on a loop; the moment the chip
+# answers, fire the full validation runbook (tools/tpu_validation.py)
+# and exit. The tunnel is intermittent (alive ~75 min in round 3), so
+# validation must launch within one probe interval of it waking.
+#
+# Usage: tools/tpu_watch.sh [out.json] [max_hours]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-tpu_validation_r4.json}"
+MAX_HOURS="${2:-11}"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  N=$(timeout 90 python -c "
+from kube_batch_tpu.utils.backend import probe_default_backend
+print(probe_default_backend(timeout=60))" 2>/dev/null | tail -1)
+  if [ "${N:-0}" -gt 0 ] 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) tunnel alive ($N devices) — running validation" >&2
+    python tools/tpu_validation.py --out "$OUT"
+    RC=$?
+    echo "$(date -u +%FT%TZ) validation rc=$RC" >&2
+    # rc=0 means the runbook completed with a live device; rc=1 means
+    # the tunnel died between probe and runbook — keep watching.
+    [ "$RC" -eq 0 ] && exit 0
+  fi
+  sleep 240
+done
+echo "$(date -u +%FT%TZ) watcher deadline reached; tunnel never answered" >&2
+exit 1
