@@ -306,13 +306,18 @@ def bench_cycle(cfg, seed=0, cache=None):
     action, _ = get_action("allocate_tpu")
 
     def one_cycle():
+        # Same GC deferral as the production Scheduler.run_once: the
+        # collection runs after t_close, in what would be think-time.
+        from kube_batch_tpu.utils import deferred_gc
+
         t_start = time.perf_counter()
-        ssn = open_session(cache, make_tiers(*TIERS_ARGS))
-        t_open = time.perf_counter()
-        action.execute(ssn)
-        t_exec = time.perf_counter()
-        close_session(ssn)
-        t_close = time.perf_counter()
+        with deferred_gc():
+            ssn = open_session(cache, make_tiers(*TIERS_ARGS))
+            t_open = time.perf_counter()
+            action.execute(ssn)
+            t_exec = time.perf_counter()
+            close_session(ssn)
+            t_close = time.perf_counter()
         out = {
             "open_ms": round((t_open - t_start) * 1e3, 1),
             "action_ms": round((t_exec - t_open) * 1e3, 1),

@@ -29,6 +29,7 @@ import time
 import numpy as np
 
 from .. import metrics
+from ..api import Resource
 from ..framework import Action, register_action
 from ..solver import solve_sharded, tensorize
 from ..utils.scheduler_helper import prioritize_nodes, select_best_node
@@ -141,26 +142,55 @@ class AllocateTpuAction(Action):
         a = np.asarray(assigned[:T])
         sel = np.nonzero(a >= 0)[0]
         all_fit = True
+        order = seg_starts = nodes_sorted = None
         if sel.size:
             nodes_sel = a[sel]
             order = np.argsort(nodes_sel, kind="stable")
+            nodes_sorted = nodes_sel[order]
             req_rows = ctx.task_req_host[sel][order]
             fit_rows = ctx.task_fit_host[sel][order]
             cum = np.cumsum(req_rows, axis=0)
             seg_starts = np.nonzero(
-                np.diff(nodes_sel[order], prepend=-1)
+                np.diff(nodes_sorted, prepend=-1)
             )[0]
             base = np.zeros_like(cum)
             base[seg_starts[1:]] = cum[seg_starts[1:] - 1]
             # exclusive within-node prefix of resreq consumption
             prefix = cum - req_rows - np.maximum.accumulate(base, axis=0)
-            idle = ctx.node_idle_host[nodes_sel[order]]
+            idle = ctx.node_idle_host[nodes_sorted]
             eps = ctx.layout.eps().astype(np.float64)
             all_fit = bool((prefix + fit_rows < idle + eps).all())
         if all_fit:
-            placed = ssn.allocate_batch(
-                [(ctx.tasks[i], ctx.nodes[a[i]].name) for i in sel]
-            )
+            if sel.size:
+                # Per-node groups straight from the fit guard's
+                # segmentation — the session path never re-groups with
+                # per-task dict passes, and each group carries its
+                # aggregate resreq delta (a cumsum difference) so node
+                # accounting skips per-task Resource math too.
+                tasks_sorted = [
+                    ctx.tasks[i] for i in sel[order].tolist()
+                ]
+                seg_list = seg_starts.tolist()
+                seg_ends = seg_list[1:] + [len(tasks_sorted)]
+                zero = np.zeros_like(cum[0])
+                layout = ctx.layout
+                mib = 1024.0 * 1024.0
+                node_groups = []
+                for s, e in zip(seg_list, seg_ends):
+                    row = cum[e - 1] - (cum[s - 1] if s else zero)
+                    delta = Resource(row[0], row[1] * mib)
+                    for k, name in enumerate(layout.scalars):
+                        v = float(row[2 + k])
+                        if v:
+                            delta.add_scalar(name, v)
+                    node_groups.append((
+                        ctx.nodes[int(nodes_sorted[s])].name,
+                        tasks_sorted[s:e],
+                        delta,
+                    ))
+                placed = ssn.allocate_batch_grouped(node_groups)
+            else:
+                placed = 0
         else:
             logger.warning(
                 "solver assignment drifted from session accounting; "
@@ -186,6 +216,11 @@ class AllocateTpuAction(Action):
 
         _record_phase("apply", (time.perf_counter() - t0) * 1e3)
         last_stats["placed"] = placed
+        # Apply sub-phase forensics from the batched session path.
+        from ..framework.session import last_apply_stats
+
+        for k, v in last_apply_stats.items():
+            last_stats[f"apply_{k}"] = v
 
         t0 = time.perf_counter()
         # Epilogue: pipeline unassigned tasks onto Releasing resources

@@ -247,6 +247,80 @@ class JobInfo:
         task.status = status
         self._add_task_index(task)
 
+    def update_tasks_status(
+        self, tasks: List[TaskInfo], status: TaskStatus
+    ) -> None:
+        """Bulk :meth:`update_task_status` toward one destination status.
+        Per-task semantics are identical (clones and missing tasks take
+        the per-task path, including its KeyError); the stored-task fast
+        path amortizes the version bump, the target-index lookup, and the
+        empty-source-bucket cleanup across the whole group — this runs 3x
+        per placement on the apply path, 150k calls per 50k-task cycle."""
+        if not tasks:
+            return
+        self._ver += 1
+        target = self.task_status_index.get(status)
+        if target is None:
+            target = self.task_status_index[status] = {}
+        now = allocated_status(status)
+
+        # Whole-bucket fast path: when the group IS one source bucket
+        # (gang dispatch moves every ALLOCATED task of a job at once),
+        # merge the bucket with one C-level dict.update instead of
+        # per-task pops/inserts; a non-flipping transition (Allocated →
+        # Binding, both allocated statuses) then needs no Resource math
+        # at all.
+        first = tasks[0]
+        src_status = first.status
+        if src_status is not status:
+            bucket = self.task_status_index.get(src_status)
+            if bucket is not None and len(bucket) == len(tasks):
+                stored_get = self.tasks.get
+                uniform = True
+                for t in tasks:
+                    if t.status is not src_status or stored_get(t.uid) is not t:
+                        uniform = False
+                        break
+                if uniform:
+                    validate_status_update(src_status, status)
+                    was = allocated_status(src_status)
+                    if was != now:
+                        agg = self.allocated
+                        if now:
+                            for t in tasks:
+                                agg.add(t.resreq)
+                        else:
+                            for t in tasks:
+                                agg.sub(t.resreq)
+                    target.update(bucket)
+                    del self.task_status_index[src_status]
+                    for t in tasks:
+                        t.status = status
+                    return
+
+        sources = set()
+        for task in tasks:
+            stored = self.tasks.get(task.uid)
+            if stored is not task:
+                self.update_task_status(task, status)
+                continue
+            validate_status_update(task.status, status)
+            src = self.task_status_index.get(task.status)
+            if src is not None:
+                src.pop(task.uid, None)
+                sources.add(task.status)
+            was = allocated_status(task.status)
+            if was and not now:
+                self.allocated.sub(task.resreq)
+            elif now and not was:
+                self.allocated.add(task.resreq)
+            task.status = status
+            target[task.uid] = task
+        for src_status in sources:
+            bucket = self.task_status_index.get(src_status)
+            if bucket is not None and not bucket:
+                del self.task_status_index[src_status]
+
     def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
         """Clones of all tasks in the given statuses (reference :210-222)."""
         res: List[TaskInfo] = []

@@ -168,6 +168,41 @@ class NodeInfo:
         for key, ti in clones:
             self.tasks[key] = ti
 
+    def add_tasks_prevalidated(
+        self, tasks: List[TaskInfo], delta: "Resource"
+    ) -> None:
+        """Session-apply fast path: place a uniform default-branch group
+        whose aggregate fit the solver's apply guard ALREADY verified,
+        with ``delta`` its precomputed resreq sum. Stores the tasks
+        THEMSELVES, not clones — only valid on session-lifetime nodes,
+        where node entries and the session's task objects die together
+        at close (the authoritative cache mirror must keep using
+        add_task/add_tasks, whose clones protect accounting across
+        cycles). Raises like :meth:`add_tasks` on duplicates or an
+        aggregate misfit, without touching node state."""
+        if not tasks:
+            return
+        new = {}
+        node_tasks = self.tasks
+        for task in tasks:
+            key = pod_key(task.pod)
+            if key in node_tasks or key in new:
+                raise ValueError(
+                    f"task <{task.namespace}/{task.name}> already on "
+                    f"node <{self.name}>"
+                )
+            new[key] = task
+        if self.node is not None:
+            if not delta.less_equal(self.idle):
+                raise ValueError(
+                    f"batch of {len(new)} tasks does not fit node "
+                    f"<{self.name}> in aggregate"
+                )
+            self.idle.sub(delta)
+            self.used.add(delta)
+        self._ver += 1
+        node_tasks.update(new)
+
     def add_tasks_with_fallback(self, tasks: List[TaskInfo]) -> List[TaskInfo]:
         """Batch-add with sequential per-task fallback, returning the
         tasks actually placed. The fallback covers the cases the strict

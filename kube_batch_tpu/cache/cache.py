@@ -173,18 +173,31 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self._executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="cache-sideeffect"
         )
+        # Mirror bookkeeping gets its own single worker: snapshot()
+        # barriers on it, so it must never queue behind a slow per-task
+        # volume bind occupying the shared pool (those can block up to
+        # the 30s bind timeout each).
+        self._bookkeeping_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="cache-bookkeeping"
+        )
         self._inflight = 0
+        self._bookkeeping_inflight = 0
         self._inflight_cond = threading.Condition()
         self._synced = cluster is None
         self._stop = threading.Event()
 
-    def _submit_side_effect(self, fn) -> None:
+    def _submit_side_effect(self, fn, bookkeeping: bool = False) -> None:
         """Run a bind/evict side effect on the async pool, tracking it so
         tests/benchmarks can barrier on completion (the reference's
         equivalent is draining the fake binder channel with a timeout,
-        allocate_test.go:199-209)."""
+        allocate_test.go:199-209). ``bookkeeping=True`` additionally
+        counts the job toward the mirror-consistency barrier that
+        :meth:`snapshot` takes — ONLY cache-mirror updates belong there;
+        a slow per-task volume bind must never stall the next cycle."""
         with self._inflight_cond:
             self._inflight += 1
+            if bookkeeping:
+                self._bookkeeping_inflight += 1
 
         def wrapped():
             try:
@@ -192,9 +205,24 @@ class SchedulerCache(Cache, EventHandlersMixin):
             finally:
                 with self._inflight_cond:
                     self._inflight -= 1
+                    if bookkeeping:
+                        self._bookkeeping_inflight -= 1
                     self._inflight_cond.notify_all()
 
-        self._executor.submit(wrapped)
+        (self._bookkeeping_executor if bookkeeping
+         else self._executor).submit(wrapped)
+
+    def wait_for_bookkeeping(self, timeout: float = 60.0) -> bool:
+        """Block until every deferred cache-mirror update (bind_batch
+        bookkeeping) has executed."""
+        deadline = time.time() + timeout
+        with self._inflight_cond:
+            while self._bookkeeping_inflight > 0:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._inflight_cond.wait(remaining)
+        return True
 
     def wait_for_side_effects(self, timeout: float = 10.0) -> bool:
         """Block until every queued async bind/evict has executed."""
@@ -330,6 +358,19 @@ class SchedulerCache(Cache, EventHandlersMixin):
         because a snapshot's objects are only ever mutated by its own
         session, and the scheduler runs sessions strictly one at a time
         (reference semantics: one runOnce per cycle, scheduler.go:84)."""
+        # Barrier on deferred bind bookkeeping (bind_batch runs the
+        # mirror update on the side-effect pool): a snapshot taken with
+        # a half-applied batch would re-place already-bound tasks. In
+        # the 1 Hz steady state the batch finished long ago and this is
+        # a no-op; a timeout degrades to the reference's behavior —
+        # schedule on the freshest mirror available and let resync
+        # reconcile. Deliberately NOT wait_for_side_effects: a slow
+        # per-task volume bind must not stall the next cycle.
+        if not self.wait_for_bookkeeping(timeout=60.0):
+            logger.warning(
+                "bind bookkeeping still in flight after 60s; snapshotting "
+                "the current mirror state"
+            )
         with self.mutex:
             snap = ClusterInfo()
             pool_jobs: Dict[str, tuple] = {}
@@ -393,14 +434,17 @@ class SchedulerCache(Cache, EventHandlersMixin):
         return job, task
 
     def _bind_bookkeeping(self, task_info: TaskInfo, hostname: str,
-                          add_to_node: bool = True):
+                          add_to_node: bool = True,
+                          update_status: bool = True):
         """Under-mutex half of bind: validate, move to Binding, and (by
         default) account on the node. Returns ``(job, task, prior)``
         where ``task`` is the STORED task and ``prior`` its
         (status, node_name) before the move — what a caller must restore
         to revert a bind the node later rejects. Caller must hold
         self.mutex. ``add_to_node=False`` defers the node accounting to
-        the caller (bind_batch groups it per node)."""
+        the caller (bind_batch groups it per node);
+        ``update_status=False`` defers the status-index move too (the
+        caller bulk-moves per job) — node_name is still set here."""
         job, task = self._find_job_and_task(task_info)
         node = self.nodes.get(hostname)
         if node is None:
@@ -414,7 +458,8 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 f"{task.status.name}, expected Pending/Allocated"
             )
         prior = (task.status, task.node_name)
-        job.update_task_status(task, TaskStatus.BINDING)
+        if update_status:
+            job.update_task_status(task, TaskStatus.BINDING)
         task.node_name = hostname
         if add_to_node:
             node.add_task(task)
@@ -461,14 +506,35 @@ class SchedulerCache(Cache, EventHandlersMixin):
     _BIND_CHUNK = 1024
 
     def bind_batch(self, task_infos) -> list:
-        """Batched :meth:`bind`: one mutex hold for all bookkeeping and a
-        handful of chunked side-effect jobs instead of one executor
-        submission + lock round trip per task (profile r3: those were ~40%
-        of the apply phase at 10k tasks). Per-task semantics are bind()'s:
+        """Batched :meth:`bind`, fully asynchronous: the cache-mirror
+        bookkeeping AND the bind side effects run on the side-effect
+        pool, overlapping the scheduler's remaining cycle and its
+        think-time between cycles — the session works on its own
+        snapshot, so nothing in the running cycle reads the cache mirror
+        (profile r4: the mirror update alone was ~870 ms of the 50k cold
+        apply). :meth:`snapshot` barriers on in-flight side effects, so
+        the NEXT cycle observes the completed bookkeeping or waits.
+
+        Returns the input tasks optimistically. The rare task whose
+        bookkeeping the node later rejects (solver drift) is reverted to
+        its prior status by the async job and rescheduled next cycle —
+        the same self-correction contract as the reference's
+        assume-then-resync bind (cache.go:480-522)."""
+        infos = list(task_infos)
+        if not infos:
+            return infos
+        self._submit_side_effect(
+            lambda: self._bind_batch_bookkeeping(infos), bookkeeping=True
+        )
+        return infos
+
+    def _bind_batch_bookkeeping(self, task_infos) -> list:
+        """Under-mutex half of bind_batch + side-effect submission.
+        Runs on the side-effect pool. Per-task semantics are bind()'s:
         validation failures are logged and skipped, side-effect failures
-        release volumes and resync that task only. Tasks whose volumes are
-        NOT ready are submitted as individual jobs — their bind may block
-        up to the volume-bind timeout, and a slow volume must not
+        release volumes and resync that task only. Tasks whose volumes
+        are NOT ready are submitted as individual jobs — their bind may
+        block up to the volume-bind timeout, and a slow volume must not
         head-of-line-block the rest of the gang. Each task_info must have
         node_name set. Returns the tasks whose bookkeeping succeeded."""
         binds = []
@@ -477,18 +543,25 @@ class SchedulerCache(Cache, EventHandlersMixin):
         with self.mutex:
             # hostname -> [(ti, stored, prior status/node for revert)]
             staged: Dict[str, list] = {}
+            by_job: Dict[int, tuple] = {}  # id(job) -> (job, [stored])
             for ti in task_infos:
                 try:
                     job, stored, prior = self._bind_bookkeeping(
-                        ti, ti.node_name, add_to_node=False
+                        ti, ti.node_name, add_to_node=False,
+                        update_status=False,
                     )
                     staged.setdefault(ti.node_name, []).append(
                         (ti, stored, job, prior)
                     )
+                    by_job.setdefault(id(job), (job, []))[1].append(stored)
                 except Exception:
                     logger.exception(
                         "failed to bind task %s/%s", ti.namespace, ti.name
                     )
+            # Status-index moves bulked per job (3rd of the 3 per-task
+            # moves on the apply path; see JobInfo.update_tasks_status).
+            for job, group in by_job.values():
+                job.update_tasks_status(group, TaskStatus.BINDING)
 
             def accept(ti, stored, hostname):
                 snapshot = stored.clone()
@@ -498,7 +571,16 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 # side effect doesn't re-wait on ready volumes.
                 snapshot.volume_ready = ti.volume_ready
                 item = (stored.pod, hostname, snapshot)
-                (binds if ti.volume_ready else slow_binds).append(item)
+                # Only a task that could actually block on a volume wait
+                # needs per-task isolation: it has claims AND they are
+                # not known-bound. A claims-less pod (the overwhelming
+                # majority in a batch cluster) can never wait, whatever
+                # volume_ready says — routing it to the slow path turns
+                # a 50k-task gang into 50k executor submissions.
+                may_wait = (
+                    not ti.volume_ready and ti.pod.spec.volume_claims
+                )
+                (slow_binds if may_wait else binds).append(item)
                 bound.append(ti)
 
             # Node accounting grouped per node (one aggregate idle/used
@@ -525,6 +607,13 @@ class SchedulerCache(Cache, EventHandlersMixin):
                         try:
                             job.update_task_status(stored, prior_status)
                             stored.node_name = prior_node
+                            # Drop the claim assumptions made at
+                            # allocate time, like the per-task failure
+                            # path (_bind_side_effect) — a stale
+                            # assumption on the rejected host would
+                            # fail every future placement of this task.
+                            if stored.pod.spec.volume_claims:
+                                self.volume_binder.release_volumes(stored)
                         except Exception:
                             logger.exception(
                                 "failed to revert rejected bind %s/%s; "
@@ -585,6 +674,31 @@ class SchedulerCache(Cache, EventHandlersMixin):
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         self.volume_binder.allocate_volumes(task, hostname)
 
+    def allocate_volumes_batch(self, tasks, hostname: str) -> list:
+        """Batched :meth:`allocate_volumes` for one node's group.
+        Claims-less pods (the overwhelming majority) are marked ready in
+        one tight loop without a seam call per task; only claim-bearing
+        pods go through the per-task binder. Returns the tasks whose
+        volume allocation succeeded (failures logged and skipped, like
+        the sequential apply loop)."""
+        ok = []
+        allocate = self.volume_binder.allocate_volumes
+        for task in tasks:
+            if not task.pod.spec.volume_claims:
+                task.volume_ready = True
+                ok.append(task)
+                continue
+            try:
+                allocate(task, hostname)
+            except Exception:
+                logger.exception(
+                    "Failed to allocate volumes of Task %s on %s",
+                    task.uid, hostname,
+                )
+                continue
+            ok.append(task)
+        return ok
+
     def bind_volumes(self, task: TaskInfo) -> None:
         """Dispatch-time seam (session.go:294-316 calls BindVolumes before
         Bind). Ready volumes short-circuit here; UNready volumes are bound
@@ -644,6 +758,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
 
     def shutdown(self) -> None:
         self._stop.set()
+        # Bookkeeping first: its jobs submit side-effect chunks onto the
+        # shared pool, so draining it before the shared pool guarantees
+        # no post-shutdown submissions.
+        self._bookkeeping_executor.shutdown(wait=True)
         self._executor.shutdown(wait=True)
 
     # String (reference cache.go String()) omitted; repr is enough.

@@ -102,5 +102,23 @@ class Cache(ABC):
     @abstractmethod
     def allocate_volumes(self, task: "TaskInfo", hostname: str) -> None: ...
 
+    def allocate_volumes_batch(self, tasks, hostname: str) -> list:
+        """Batched volume allocation for one node's group (TPU-native
+        extension). Default falls back to per-task allocate_volumes;
+        SchedulerCache overrides with the claims-aware fast path.
+        Returns the tasks that succeeded."""
+        ok = []
+        for task in tasks:
+            try:
+                self.allocate_volumes(task, hostname)
+            except Exception:
+                logger.exception(
+                    "failed to allocate volumes of %s/%s",
+                    task.namespace, task.name,
+                )
+                continue
+            ok.append(task)
+        return ok
+
     @abstractmethod
     def bind_volumes(self, task: "TaskInfo") -> None: ...

@@ -36,6 +36,26 @@ from .event import Event, EventHandler
 
 logger = logging.getLogger(__name__)
 
+# Sub-phase wall times of the most recent allocate_batch (bench/perf
+# forensics; the allocate_tpu action folds these into its last_stats).
+last_apply_stats: dict = {}
+
+
+def _move_tasks_logged(job, tasks, status):
+    """Bulk status move with the sequential loop's failure semantics: a
+    group-level error degrades to per-task moves where each failure is
+    logged and skipped instead of aborting the job's whole group."""
+    try:
+        job.update_tasks_status(tasks, status)
+    except Exception:
+        for task in tasks:
+            try:
+                job.update_task_status(task, status)
+            except Exception:
+                logger.exception(
+                    "Failed to move Task %s to %s", task.uid, status
+                )
+
 
 class Session:
     def __init__(self, cache, tiers: Optional[List[Tier]] = None):
@@ -224,44 +244,87 @@ class Session:
           dispatched, is identical);
         - per-task failures are logged and skipped, not fatal.
 
-        Returns the number of tasks allocated."""
-        staged: Dict[str, list] = {}  # hostname -> [(task, job)]
+        Returns the number of tasks allocated.
+
+        Thin wrapper: groups the pairs per hostname and delegates to
+        :meth:`allocate_batch_grouped` (one implementation of the apply
+        tail — events, handlers, gang dispatch — not two to keep in
+        sync). allocate_tpu builds the groups itself from the solver's
+        arrays and calls the grouped form directly."""
+        staged: Dict[str, list] = {}  # hostname -> [tasks]
         for task, hostname in pairs:
-            job = self.jobs.get(task.job)
-            if job is None:
-                logger.warning("failed to find job %s", task.job)
-                continue
+            group = staged.get(hostname)
+            if group is None:
+                group = staged[hostname] = []
+            group.append(task)
+        return self.allocate_batch_grouped(
+            [(hostname, tasks, None) for hostname, tasks in staged.items()]
+        )
+
+    def allocate_batch_grouped(self, node_groups) -> int:
+        """Apply a solved assignment set from PRE-GROUPED per-node lists
+        — the zero-regroup fast path for allocate_tpu, whose fit guard
+        already computed the per-node segmentation with numpy.
+
+        ``node_groups`` is ``[(hostname, [tasks], delta)]`` where
+        ``delta`` is the group's precomputed aggregate resreq (or None);
+        tasks carry no node_name yet. Semantics are
+        :meth:`allocate_batch`'s (volumes, status moves, node
+        accounting, plugin events, gang dispatch); only the staging
+        differs: per-node loops replace the 50k per-task dict passes.
+        Returns the number of tasks allocated."""
+        last_apply_stats.clear()
+        t0 = _time.perf_counter()
+        alloc_groups: List[tuple] = []  # (hostname, node, [tasks], delta)
+        for hostname, tasks, delta in node_groups:
             node = self.nodes.get(hostname)
             if node is None:
                 logger.warning("failed to find node %s", hostname)
                 continue
-            try:
-                self.cache.allocate_volumes(task, hostname)
-                job.update_task_status(task, TaskStatus.ALLOCATED)
+            ok = self.cache.allocate_volumes_batch(tasks, hostname)
+            for task in ok:
                 task.node_name = hostname
-            except Exception:
-                logger.exception(
-                    "Failed to allocate Task %s on %s", task.uid, hostname
-                )
+            alloc_groups.append((
+                hostname, node, ok, delta if len(ok) == len(tasks) else None
+            ))
+        # Per-job ALLOCATED moves: group with one argsort-free pass
+        # (tasks of one job may span many nodes).
+        by_job: Dict[str, list] = {}
+        for _, _, tasks, _ in alloc_groups:
+            for task in tasks:
+                group = by_job.get(task.job)
+                if group is None:
+                    group = by_job[task.job] = []
+                group.append(task)
+        jobs_by_uid: Dict[str, JobInfo] = {}
+        for uid, group in by_job.items():
+            job = self.jobs.get(uid)
+            if job is None:
+                logger.warning("failed to find job %s", uid)
                 continue
-            staged.setdefault(hostname, []).append((task, job))
+            jobs_by_uid[uid] = job
+            _move_tasks_logged(job, group, TaskStatus.ALLOCATED)
+        t1 = _time.perf_counter()
+        last_apply_stats["stage_ms"] = (t1 - t0) * 1e3
 
-        # Node accounting per NODE, not per task: one aggregate
-        # idle/used update for each node's group, with the per-task
-        # fallback policy in NodeInfo.add_tasks_with_fallback.
         events: List[Event] = []
-        jobs_touched: Dict[str, JobInfo] = {}
-        for hostname, items in staged.items():
-            node = self.nodes[hostname]
-            ok = {
-                id(t) for t in node.add_tasks_with_fallback(
-                    [t for t, _ in items]
-                )
-            }
-            for task, job in items:
-                if id(task) in ok:
-                    events.append(Event(task))
-                    jobs_touched[job.uid] = job
+        for hostname, node, tasks, delta in alloc_groups:
+            if delta is not None:
+                try:
+                    node.add_tasks_prevalidated(tasks, delta)
+                    for task in tasks:
+                        events.append(Event(task))
+                    continue
+                except Exception:
+                    logger.exception(
+                        "prevalidated group rejected by node %s; "
+                        "falling back to guarded add", hostname,
+                    )
+            placed_list = node.add_tasks_with_fallback(tasks)
+            for task in placed_list:
+                events.append(Event(task))
+        t2 = _time.perf_counter()
+        last_apply_stats["account_ms"] = (t2 - t1) * 1e3
         if not events:
             return 0
         for eh in self.event_handlers:
@@ -270,14 +333,51 @@ class Session:
             elif eh.allocate_func is not None:
                 for ev in events:
                     eh.allocate_func(ev)
-        for job in jobs_touched.values():
+        t3 = _time.perf_counter()
+        last_apply_stats["handlers_ms"] = (t3 - t2) * 1e3
+
+        dispatch_groups: List[tuple] = []
+        for uid, job in jobs_by_uid.items():
             if self.job_ready(job):
-                self.dispatch_batch(list(
+                dispatch_groups.append((job, list(
                     job.task_status_index.get(
                         TaskStatus.ALLOCATED, {}
                     ).values()
-                ))
+                )))
+        if dispatch_groups:
+            self.dispatch_batch_grouped(dispatch_groups)
+        last_apply_stats["dispatch_ms"] = (
+            _time.perf_counter() - t3
+        ) * 1e3
         return len(events)
+
+    def dispatch_batch_grouped(self, groups) -> None:
+        """Bind ready gangs from per-job groups: bulk BINDING moves per
+        job (no regrouping pass), one batched metrics observe, one
+        bind_batch submission."""
+        all_ready: List[TaskInfo] = []
+        for job, tasks in groups:
+            ready: List[TaskInfo] = []
+            for task in tasks:
+                # bind_volumes is a no-op for ready-volume tasks (the
+                # overwhelming majority: claims-less pods).
+                if not task.volume_ready:
+                    try:
+                        self.cache.bind_volumes(task)
+                    except Exception:
+                        logger.exception(
+                            "Failed to bind volumes of %s", task.uid
+                        )
+                        continue
+                ready.append(task)
+            _move_tasks_logged(job, ready, TaskStatus.BINDING)
+            all_ready.extend(ready)
+        self.cache.bind_batch(all_ready)
+        now = _time.time()
+        metrics.update_task_schedule_durations([
+            max(0.0, now - t.pod.metadata.creation_timestamp)
+            for t in all_ready
+        ])
 
     def dispatch(self, task: TaskInfo) -> None:
         """Bind one gang member (reference session.go:294-318)."""
@@ -294,26 +394,24 @@ class Session:
 
     def dispatch_batch(self, tasks: List[TaskInfo]) -> None:
         """Bind a whole ready gang with one cache round trip (one mutex
-        hold, one async side-effect job) instead of per-task dispatch."""
-        ready: List[TaskInfo] = []
+        hold, one async side-effect job) instead of per-task dispatch.
+        Thin wrapper: groups per job and delegates to
+        :meth:`dispatch_batch_grouped`."""
+        by_job: Dict[str, list] = {}
         for task in tasks:
-            try:
-                self.cache.bind_volumes(task)
-            except Exception:
-                logger.exception("Failed to bind volumes of %s", task.uid)
-                continue
-            ready.append(task)
-        bound = self.cache.bind_batch(ready)
-        now = _time.time()
-        for task in bound:
-            job = self.jobs.get(task.job)
+            group = by_job.get(task.job)
+            if group is None:
+                group = by_job[task.job] = []
+            group.append(task)
+        groups = []
+        for uid, group in by_job.items():
+            job = self.jobs.get(uid)
             if job is None:
-                logger.warning("failed to find job %s", task.job)
+                logger.warning("failed to find job %s", uid)
                 continue
-            job.update_task_status(task, TaskStatus.BINDING)
-            metrics.update_task_schedule_duration(
-                max(0.0, now - task.pod.metadata.creation_timestamp)
-            )
+            groups.append((job, group))
+        if groups:
+            self.dispatch_batch_grouped(groups)
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """Direct eviction (reference session.go:321-358)."""

@@ -90,6 +90,30 @@ class Histogram(_Metric):
             self._sums[labels] = self._sums.get(labels, 0.0) + value
             self._totals[labels] = self._totals.get(labels, 0) + 1
 
+    def observe_many(self, values, labels: Tuple = ()) -> None:
+        """Batched :meth:`observe`: one lock hold and vectorized bucket
+        math for a whole array of samples (50k per cold apply — the
+        per-call Python bucket loop was measurable there)."""
+        import numpy as np
+
+        arr = np.asarray(values, dtype=np.float64)
+        if arr.size == 0:
+            return
+        n_buckets = len(self.buckets)
+        idx = np.searchsorted(self.buckets, arr, side="left")
+        binc = np.bincount(idx, minlength=n_buckets + 1)
+        # observe() adds 1 to every bucket >= the sample's: bucket i
+        # gains the count of samples with idx <= i (cumulative counts).
+        cum = np.cumsum(binc[:n_buckets])
+        with self._lock:
+            if labels not in self._counts:
+                self._counts[labels] = [0] * n_buckets
+            counts = self._counts[labels]
+            for i in range(n_buckets):
+                counts[i] += int(cum[i])
+            self._sums[labels] = self._sums.get(labels, 0.0) + float(arr.sum())
+            self._totals[labels] = self._totals.get(labels, 0) + int(arr.size)
+
     def count(self, labels: Tuple = ()) -> int:
         return self._totals.get(labels, 0)
 
@@ -201,6 +225,11 @@ def update_action_duration(action: str, seconds: float) -> None:
 
 def update_task_schedule_duration(seconds: float) -> None:
     task_scheduling_latency.observe(seconds)
+
+
+def update_task_schedule_durations(seconds_list) -> None:
+    """Batched form for the 50k-task apply path."""
+    task_scheduling_latency.observe_many(seconds_list)
 
 
 def update_pod_group_phase(phase: str, count: int) -> None:

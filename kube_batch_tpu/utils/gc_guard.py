@@ -1,0 +1,43 @@
+"""Cycle-scoped garbage-collection deferral.
+
+A 50k-task apply allocates ~100k short-lived objects (events, clones,
+dict entries); CPython's generational GC triggers multiple collections
+inside the scheduling cycle, and full collections scan the ~1M-object
+cluster mirror — measured ~350 ms of the cold 50k apply (r4 profile),
+indistinguishable from "slow bookkeeping" until isolated.
+
+The Go reference pays this as concurrent GC; CPython stops the world.
+``deferred_gc()`` moves the cost off the critical path: collection is
+disabled for the duration of the cycle and a bounded young-generation
+collection runs on exit — in the scheduler's think-time gap, where a
+pause costs nothing. Nesting is safe (only the outermost guard
+re-enables); an exception still restores GC.
+"""
+
+from __future__ import annotations
+
+import gc
+from contextlib import contextmanager
+
+_depth = 0
+
+
+@contextmanager
+def deferred_gc(collect_generation: int = 1):
+    """Disable GC for the guarded block; on exit, re-enable and run one
+    ``gc.collect(collect_generation)`` (default: young+middle
+    generations — bounded, does not scan the full mirror). Pass -1 to
+    skip the exit collection entirely."""
+    global _depth
+    _depth += 1
+    was_enabled = gc.isenabled()
+    if was_enabled:
+        gc.disable()
+    try:
+        yield
+    finally:
+        _depth -= 1
+        if was_enabled and _depth == 0:
+            gc.enable()
+            if collect_generation >= 0:
+                gc.collect(collect_generation)

@@ -145,10 +145,12 @@ class FakeStatusUpdater:
 
 
 class FakeVolumeBinder:
-    """No-op (reference test_utils.go:150-163)."""
+    """No-op (reference test_utils.go:150-163). Marks volumes ready like
+    DefaultVolumeBinder's no-cluster behavior, so fakes exercise the same
+    fast bind path production takes for claims-less pods."""
 
     def allocate_volumes(self, task, hostname: str) -> None:
-        return None
+        task.volume_ready = True
 
     def bind_volumes(self, task) -> None:
         return None

@@ -315,8 +315,10 @@ class TestSideEffects:
             info.node_name = "n1"  # both target n1; only one cpu fits
             info.volume_ready = True
 
-        bound = c.bind_batch(infos)
-        assert len(bound) == 1
+        # bind_batch is optimistic (bookkeeping is deferred to the
+        # side-effect pool); barrier before asserting mirror state.
+        c.bind_batch(infos)
+        assert c.wait_for_bookkeeping(timeout=10)
         assert {t.status for t in tasks} == {
             TaskStatus.BINDING, TaskStatus.PENDING
         }
