@@ -126,6 +126,17 @@ class DefaultVolumeBinder(VolumeBinder):
             self.cluster.release_pod_volumes(task.pod)
 
 
+def _pool_entry(obj):
+    """COW snapshot-pool entry for a job/node: ``(source version, clone,
+    clone version)``. snapshot() reuses the clone while BOTH versions
+    still match (source unchanged since the clone was cut, clone not
+    mutated by the session it was handed to). Sole constructor of the
+    entry shape — snapshot() and the bind-bookkeeping prewarm must stay
+    in lockstep on this invariant."""
+    clone = obj.clone()
+    return (obj._ver, clone, clone._ver)
+
+
 class SchedulerCache(Cache, EventHandlersMixin):
     def __init__(
         self,
@@ -385,11 +396,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     and entry[0] == node._ver
                     and entry[2] == entry[1]._ver
                 ):
-                    clone = entry[1]
+                    pool_nodes[name] = entry
                 else:
-                    clone = node.clone()
-                pool_nodes[name] = (node._ver, clone, clone._ver)
-                snap.nodes[name] = clone
+                    entry = pool_nodes[name] = _pool_entry(node)
+                snap.nodes[name] = entry[1]
             for name, q in self.queues.items():
                 snap.queues[name] = q.clone()
             for key, job in self.jobs.items():
@@ -412,11 +422,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     and entry[2] == entry[1]._ver
                     and entry[1].priority == job.priority
                 ):
-                    clone = entry[1]
+                    pool_jobs[key] = entry
                 else:
-                    clone = job.clone()
-                pool_jobs[key] = (job._ver, clone, clone._ver)
-                snap.jobs[key] = clone
+                    entry = pool_jobs[key] = _pool_entry(job)
+                snap.jobs[key] = entry[1]
             # Entries for deleted objects fall away with the pool swap.
             self._snap_pool = (pool_jobs, pool_nodes)
             return snap
@@ -625,6 +634,32 @@ class SchedulerCache(Cache, EventHandlersMixin):
                             "reverted to %s", hostname, ti.namespace,
                             ti.name, prior_status.name,
                         )
+
+        # Pre-warm the COW snapshot pool for everything this batch
+        # dirtied: re-clone the touched jobs/nodes HERE, on the
+        # bookkeeping worker, so the next cycle's snapshot reuses them
+        # instead of paying a full-world re-clone after a busy cycle
+        # (steady open was ~200 ms at 50k — pure clone cost). Open cost
+        # then scales with what changed SINCE this batch, not with
+        # cluster size. Against a live API server, bind-confirmation
+        # watch events re-dirty these objects and the next snapshot
+        # re-clones them anyway — then the prewarm is wasted worker
+        # time, but it never blocks the scheduling loop, and the cycle
+        # cost is identical to not prewarming. Per-object lock holds
+        # (not one long hold) so a concurrent watch burst interleaves;
+        # _snap_pool is re-read under each hold because snapshot()
+        # swaps the pool maps. snapshot() cannot run concurrently with
+        # this (it barriers on bookkeeping), so entries cannot be lost
+        # to a swap mid-loop except on barrier timeout — where dropped
+        # entries only cost a re-clone.
+        for job, _ in by_job.values():
+            with self.mutex:
+                self._snap_pool[0][job.uid] = _pool_entry(job)
+        for hostname in staged:
+            with self.mutex:
+                node = self.nodes.get(hostname)
+                if node is not None:
+                    self._snap_pool[1][hostname] = _pool_entry(node)
 
         if self.binder is not None:
             def _do_binds(chunk):

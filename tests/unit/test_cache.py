@@ -329,6 +329,34 @@ class TestSideEffects:
         key = c.binder.channel.get(timeout=3)
         assert key == f"ns/{accepted.name}"
 
+    def test_bind_batch_prewarns_snapshot_pool(self):
+        # The deferred bookkeeping re-clones the jobs/nodes it dirtied
+        # into the COW pool, so the NEXT snapshot reuses those clones
+        # instead of re-cloning the world after a busy cycle (steady
+        # open must scale with churn, not cluster size).
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list(cpu="4", memory="8Gi")))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=1))
+        p = build_pod("ns", "p1", "", PodPhase.PENDING, req(),
+                      group_name="pg1")
+        c.add_pod(p)
+        task = c.jobs["ns/pg1"].tasks[p.metadata.uid]
+        info = task.clone()
+        info.node_name = "n1"
+        info.volume_ready = True
+
+        c.bind_batch([info])
+        assert c.wait_for_bookkeeping(timeout=10)
+        prewarmed_job = c._snap_pool[0]["ns/pg1"][1]
+        prewarmed_node = c._snap_pool[1]["n1"][1]
+        snap = c.snapshot()
+        assert snap.jobs["ns/pg1"] is prewarmed_job
+        assert snap.nodes["n1"] is prewarmed_node
+        # and the pre-warmed clone reflects the bookkeeping
+        assert snap.jobs["ns/pg1"].tasks[task.uid].status \
+            == TaskStatus.BINDING
+        assert snap.nodes["n1"].used.milli_cpu == 1000
+
 
 class TestSnapshotPool:
     """COW snapshot pool: unchanged objects are reused across consecutive
