@@ -502,6 +502,7 @@ def _solve_round(
         bid, any_feas = pallas_bid(
             task_fit, task_req, task_ok, feas, idle, node_cap, cap_ok,
             eps, lr_weight, br_weight,
+            static_score=static_score if static_score.ndim else None,
         )
         failed = failed | (task_ok & ~any_feas & ~fits_releasing)
         bid = jnp.where(blocked_of(failed), N, bid)
@@ -578,11 +579,12 @@ def _solve_round(
     return assigned, idle, ntask, qalloc, failed, any_accept
 
 
-def _should_use_pallas(static_score, T: int) -> bool:
+def _should_use_pallas() -> bool:
     """Trace-time gate for the fused Pallas bid pass: opt-in via
-    KBT_PALLAS=1, TPU backend only, padded task axis, and no static score
-    rows (the kernel does not implement the sparse-row add)."""
-    from .pallas_kernels import TILE_T, pallas_enabled
+    KBT_PALLAS=1 and TPU backend only. The kernel itself handles any T
+    (internal padding to TILE_T) and static plugin score rows, so the
+    standard nodeorder/affinity configuration runs fused too."""
+    from .pallas_kernels import pallas_enabled
 
     if not pallas_enabled():
         return False
@@ -590,9 +592,7 @@ def _should_use_pallas(static_score, T: int) -> bool:
         backend = jax.default_backend()
     except Exception:  # pragma: no cover
         return False
-    return (
-        backend == "tpu" and static_score.ndim == 0 and T % TILE_T == 0
-    )
+    return backend == "tpu"
 
 
 def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
@@ -659,7 +659,7 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
         node_cap=inputs.node_cap, node_max_tasks=inputs.node_max_tasks,
         queue_deserved=inputs.queue_deserved,
         lr_weight=inputs.lr_weight, br_weight=inputs.br_weight, eps=eps,
-        use_pallas=_should_use_pallas(static_score, T),
+        use_pallas=_should_use_pallas(),
     )
 
     def body(state):
@@ -764,7 +764,7 @@ def solve_staged(
         fits_releasing=fits_releasing, blocked_of=job_blocked,
         # The tail stays on the jnp path: its bid-key hash uses GLOBAL
         # task ids (idxs) while the kernel hashes row positions.
-        use_pallas=_should_use_pallas(static_score, T),
+        use_pallas=_should_use_pallas(),
         **shared_kw,
     )
 

@@ -51,12 +51,15 @@ def _bid_kernel(
     cap_ref,      # f32[N, R]
     cap_ok_ref,   # bool[1, N]
     misc_ref,     # f32[1, R + 2] eps, lr_w, br_w
-    bid_ref,      # i32[TILE_T, 1] out
-    any_ref,      # bool[TILE_T, 1] out
-    *,
+    *refs,        # [static_ref f32[TILE_T, N] if has_static,] bid, any
     R: int,
     N: int,
+    has_static: bool,
 ):
+    if has_static:
+        static_ref, bid_ref, any_ref = refs
+    else:
+        static_ref, (bid_ref, any_ref) = None, refs
     idle = idle_ref[:]                                   # [N, R]
     cap = cap_ref[:]
 
@@ -104,6 +107,11 @@ def _bid_kernel(
         MAX_PRIORITY - jnp.abs(frac_cpu - frac_mem) * MAX_PRIORITY,
     )
     score = lr_w * lr + br_w * br
+    if has_static:
+        # Static plugin score rows (node/pod affinity, nodeorder
+        # prioritizers) — dense [T, N], added exactly like the jnp
+        # chain's `dynamic + static` (kernels._solve_round step 4).
+        score = score + static_ref[:]
 
     # Integer bid keys (kernels.bid_keys semantics, inlined).
     t_ids = (
@@ -147,46 +155,66 @@ def pallas_bid(
     eps,        # f32[R]
     lr_weight,  # f32[]
     br_weight,  # f32[]
+    static_score=None,  # f32[T, N] plugin score rows, or None
     interpret: bool = False,
 ):
     """Fused mask+score+key+argmax; returns (bid i32[T], any_feas bool[T])
-    with bid == N for tasks with no feasible node."""
+    with bid == N for tasks with no feasible node. The task axis is
+    padded to TILE_T internally (padded rows get task_ok=False), so any
+    T works; ``static_score`` adds dense plugin score rows, enabling the
+    kernel under the standard nodeorder/affinity configuration."""
     T, R = task_fit.shape
     N = idle.shape[0]
-    assert T % TILE_T == 0, f"task axis {T} must be padded to {TILE_T}"
+    pad = (-T) % TILE_T
+    if pad:
+        task_fit = jnp.pad(task_fit, ((0, pad), (0, 0)))
+        task_req = jnp.pad(task_req, ((0, pad), (0, 0)))
+        task_ok = jnp.pad(task_ok, (0, pad))  # False: padded rows bid N
+        feas = jnp.pad(feas, ((0, pad), (0, 0)))
+        if static_score is not None:
+            static_score = jnp.pad(static_score, ((0, pad), (0, 0)))
+    Tp = T + pad
     misc = jnp.concatenate(
         [eps, lr_weight[None], br_weight[None]]
     ).astype(jnp.float32)[None, :]
 
     pl = _pl()
-    grid = (T // TILE_T,)
-    kernel = functools.partial(_bid_kernel, pl, R=R, N=N)
+    grid = (Tp // TILE_T,)
+    has_static = static_score is not None
+    kernel = functools.partial(
+        _bid_kernel, pl, R=R, N=N, has_static=has_static
+    )
+    in_specs = [
+        pl.BlockSpec((TILE_T, R), lambda i: (i, 0)),
+        pl.BlockSpec((TILE_T, R), lambda i: (i, 0)),
+        pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
+        pl.BlockSpec((TILE_T, N), lambda i: (i, 0)),
+        pl.BlockSpec((N, R), lambda i: (0, 0)),
+        pl.BlockSpec((N, R), lambda i: (0, 0)),
+        pl.BlockSpec((1, N), lambda i: (0, 0)),
+        pl.BlockSpec((1, R + 2), lambda i: (0, 0)),
+    ]
+    operands = [
+        task_fit, task_req, task_ok[:, None], feas,
+        idle, cap, cap_ok[None, :], misc,
+    ]
+    if has_static:
+        in_specs.append(pl.BlockSpec((TILE_T, N), lambda i: (i, 0)))
+        operands.append(static_score.astype(jnp.float32))
     bid, any_feas = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((TILE_T, R), lambda i: (i, 0)),
-            pl.BlockSpec((TILE_T, R), lambda i: (i, 0)),
-            pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
-            pl.BlockSpec((TILE_T, N), lambda i: (i, 0)),
-            pl.BlockSpec((N, R), lambda i: (0, 0)),
-            pl.BlockSpec((N, R), lambda i: (0, 0)),
-            pl.BlockSpec((1, N), lambda i: (0, 0)),
-            pl.BlockSpec((1, R + 2), lambda i: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
             pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((T, 1), jnp.int32),
-            jax.ShapeDtypeStruct((T, 1), jnp.bool_),
+            jax.ShapeDtypeStruct((Tp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((Tp, 1), jnp.bool_),
         ),
         interpret=interpret,
-    )(
-        task_fit, task_req, task_ok[:, None], feas,
-        idle, cap, cap_ok[None, :], misc,
-    )
-    bid = bid[:, 0]
-    any_feas = any_feas[:, 0]
+    )(*operands)
+    bid = bid[:T, 0]
+    any_feas = any_feas[:T, 0]
     return jnp.where(any_feas, bid, N), any_feas

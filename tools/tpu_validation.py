@@ -142,8 +142,10 @@ from tests.solver.test_pallas import jnp_reference_bid, _random_case
 from kube_batch_tpu.solver.pallas_kernels import pallas_bid, TILE_T
 
 ok = True
-for seed in (0, 1, 2):
-    case = _random_case(seed, T=2 * TILE_T, N=256)
+# Base cases, an UNALIGNED task axis (internal padding), and STATIC
+# score rows (the standard nodeorder config) — all compiled on TPU.
+for seed, T in ((0, 2 * TILE_T), (1, 2 * TILE_T), (2, TILE_T + 57)):
+    case = _random_case(seed, T=T, N=256)
     args = (case["task_fit"], case["task_req"], case["task_ok"],
             case["feas"], case["idle"], case["cap"], case["cap_ok"],
             case["eps"], case["lr_w"], case["br_w"])
@@ -151,6 +153,18 @@ for seed in (0, 1, 2):
     bid_r, any_r = jnp_reference_bid(*args)
     ok &= bool((np.asarray(bid_p) == np.asarray(bid_r)).all())
     ok &= bool((np.asarray(any_p) == np.asarray(any_r)).all())
+
+import jax.numpy as jnp
+case = _random_case(7, T=2 * TILE_T, N=256)
+rng = np.random.RandomState(107)
+static = jnp.asarray(rng.uniform(0, 10, (2 * TILE_T, 256)).astype(np.float32))
+args = (case["task_fit"], case["task_req"], case["task_ok"], case["feas"],
+        case["idle"], case["cap"], case["cap_ok"], case["eps"],
+        case["lr_w"], case["br_w"])
+bid_p, any_p = pallas_bid(*args, static_score=static, interpret=False)
+bid_r, any_r = jnp_reference_bid(*args, static_score=static)
+ok &= bool((np.asarray(bid_p) == np.asarray(bid_r)).all())
+ok &= bool((np.asarray(any_p) == np.asarray(any_r)).all())
 print(json.dumps({"pallas_compiled_parity": ok}))
 """ % REPO
     try:
