@@ -595,7 +595,8 @@ def _should_use_pallas() -> bool:
     return backend == "tpu"
 
 
-def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
+def solve(inputs: SolverInputs, max_rounds: int = 256,
+          allow_pallas: bool = True) -> SolverResult:
     """Run the round-based batched allocation to a fixed point.
 
     Jit-safe; wrap with `jax.jit(solve, static_argnames=("max_rounds",))`
@@ -659,7 +660,7 @@ def solve(inputs: SolverInputs, max_rounds: int = 256) -> SolverResult:
         node_cap=inputs.node_cap, node_max_tasks=inputs.node_max_tasks,
         queue_deserved=inputs.queue_deserved,
         lr_weight=inputs.lr_weight, br_weight=inputs.br_weight, eps=eps,
-        use_pallas=_should_use_pallas(),
+        use_pallas=allow_pallas and _should_use_pallas(),
     )
 
     def body(state):
@@ -692,6 +693,7 @@ def solve_staged(
     inputs: SolverInputs,
     max_rounds: int = 256,
     tail_bucket: int = 3072,
+    allow_pallas: bool = True,
 ) -> SolverResult:
     """Two-stage variant of :func:`solve` for large snapshots.
 
@@ -764,7 +766,7 @@ def solve_staged(
         fits_releasing=fits_releasing, blocked_of=job_blocked,
         # The tail stays on the jnp path: its bid-key hash uses GLOBAL
         # task ids (idxs) while the kernel hashes row positions.
-        use_pallas=_should_use_pallas(),
+        use_pallas=allow_pallas and _should_use_pallas(),
         **shared_kw,
     )
 
@@ -969,18 +971,25 @@ _STAGED_MIN_NODES = 768
 _STAGED_MIN_TASKS = 16384
 
 
-def solve_auto(inputs, max_rounds: int = 256) -> SolverResult:
+def solve_auto(inputs, max_rounds: int = 256,
+               allow_pallas: bool = True) -> SolverResult:
     """Dispatch to the full or staged solver by (static) snapshot shape."""
     shaped = inputs.unpack() if isinstance(inputs, PackedInputs) else inputs
     T = shaped.task_req.shape[0]
     N = shaped.node_idle.shape[0]
     if N >= _STAGED_MIN_NODES and T >= _STAGED_MIN_TASKS:
-        return solve_staged(shaped, max_rounds=max_rounds)
-    return solve(shaped, max_rounds=max_rounds)
+        return solve_staged(shaped, max_rounds=max_rounds,
+                            allow_pallas=allow_pallas)
+    return solve(shaped, max_rounds=max_rounds, allow_pallas=allow_pallas)
 
 
-solve_jit = jax.jit(solve_auto, static_argnames=("max_rounds",))
-solve_full_jit = jax.jit(solve, static_argnames=("max_rounds",))
+solve_jit = jax.jit(
+    solve_auto, static_argnames=("max_rounds", "allow_pallas")
+)
+solve_full_jit = jax.jit(
+    solve, static_argnames=("max_rounds", "allow_pallas")
+)
 solve_staged_jit = jax.jit(
-    solve_staged, static_argnames=("max_rounds", "tail_bucket")
+    solve_staged,
+    static_argnames=("max_rounds", "tail_bucket", "allow_pallas"),
 )

@@ -153,8 +153,14 @@ def _sharded_step(mesh: Mesh, shardings, staged, max_rounds, tail_bucket):
         fn = functools.partial(solve_staged, tail_bucket=tail_bucket)
     else:
         fn = solve
+    # allow_pallas=False: pallas_call has no GSPMD partitioning rule, so
+    # under a node-sharded mesh it would force XLA to gather the [T, N]
+    # operands whole onto every device (or fail to lower) — the fused
+    # kernel is a single-device optimization; the sharded path keeps the
+    # jnp chain, which partitions cleanly.
     return jax.jit(
-        lambda x: fn(x, max_rounds=max_rounds), in_shardings=(shardings,)
+        lambda x: fn(x, max_rounds=max_rounds, allow_pallas=False),
+        in_shardings=(shardings,),
     )
 
 

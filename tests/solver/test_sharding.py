@@ -100,6 +100,17 @@ class TestShardedParity:
         sharded = solve_sharded(inputs, mesh, max_rounds=64, staged=False)
         assert_same_result(single, sharded, 20)
 
+    def test_large_ragged_node_count(self, mesh):
+        # Large N NOT divisible by 8 (1001 -> 8 shards of 126 with a
+        # ragged pad): collective/padding bugs that only appear with
+        # large uneven shards would hide at the ~20-node shapes the
+        # other parity cases use (VERDICT r3 weakness 6).
+        inputs = synthetic_inputs(256, 1001, seed=13)
+        single = solve(inputs, max_rounds=64)
+        sharded = solve_sharded(inputs, mesh, max_rounds=64, staged=False)
+        assert_same_result(single, sharded, 1001)
+        assert int((np.asarray(sharded.assigned) >= 0).sum()) > 0
+
     def test_staged_matches_full(self, mesh):
         # Small tail bucket forces the staged head/tail structure.
         inputs = synthetic_inputs(128, 64, seed=3)
