@@ -20,10 +20,13 @@ e2e:
 	$(PY) -m pytest tests/e2e -x -q
 
 # Density perf harness at the reference's kubemark design scale
-# (doc/design/Benchmark/kubemark/kubemark-benchmarking.md:40).
+# (doc/design/Benchmark/kubemark/kubemark-benchmarking.md:40), plus the
+# BASELINE config (5) multitenant reclaim scenario at 1k nodes.
 perf:
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 3000 --nodes 100 \
 		--group-size 30 --out perf-artifact.json
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --scenario multitenant --timeout 900 \
+		--nodes 1000 --group-size 10 --out perf-multitenant.json
 
 # Headline benchmark (real accelerator when present).
 bench:
@@ -39,9 +42,12 @@ verify:
 # The exact CI pipeline (.github/workflows/ci.yml), runnable locally:
 # verify -> native -> test -> perf smoke -> bench smoke
 # (reference .travis.yml:21-25).
+# The smoke run writes its OWN artifact: `make ci` after `make perf`
+# must not clobber the committed design-scale perf-artifact.json with a
+# 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
 ci: verify native test
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
-		--group-size 10 --out perf-artifact.json
+		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small
 
 # Scheduler container (reference deployment/images/Dockerfile analog).

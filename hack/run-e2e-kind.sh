@@ -1,0 +1,195 @@
+#!/usr/bin/env bash
+# Disposable-real-cluster e2e: the reference hack/run-e2e-kind.sh:46-82
+# analog, end to end. Creates a kind cluster, installs the CRDs and the
+# Helm chart (scheduler image built and side-loaded), runs a gang spec
+# and a preempt spec via kubectl against the REAL apiserver (its
+# validation/RBAC/conflict behavior — what the in-repo fake cannot
+# prove), then tears everything down.
+#
+# Requirements (documented, NOT vendored): docker, kind, kubectl, helm.
+# This script cannot run in network-restricted sandboxes; CI wires it
+# as an optional job (.github/workflows/ci.yml, workflow_dispatch).
+#
+# Usage: ./hack/run-e2e-kind.sh [--keep]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CLUSTER=tpu-batch-e2e
+NS=tpu-batch-e2e
+KEEP="${1:-}"
+
+for bin in docker kind kubectl helm; do
+    command -v "$bin" >/dev/null || { echo "$bin not found" >&2; exit 2; }
+done
+
+cleanup() {
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "==== scheduler logs ====" >&2
+        kubectl logs -n kube-system deploy/tpu-batch --tail=100 >&2 || true
+        kubectl get pods -n "$NS" -o wide >&2 || true
+        kubectl get podgroups -n "$NS" -o yaml >&2 || true
+    fi
+    [ "$KEEP" = "--keep" ] || kind delete cluster --name "$CLUSTER" || true
+}
+trap cleanup EXIT
+
+# -- cluster up (reference run-e2e-kind.sh:46-52) -------------------------
+kind create cluster --name "$CLUSTER" --wait 120s
+kubectl config use-context "kind-$CLUSTER"
+
+# -- scheduler image + chart (reference :66-79, helm path) ----------------
+docker build -f deployment/images/Dockerfile -t tpu-batch:latest .
+kind load docker-image tpu-batch:latest --name "$CLUSTER"
+kubectl apply -f config/crds/
+helm install tpu-batch deployment/tpu-batch --namespace kube-system \
+    --set image.repository=tpu-batch --set image.tag=latest \
+    --set image.pullPolicy=IfNotPresent
+kubectl rollout status -n kube-system deploy/tpu-batch --timeout=120s
+
+kubectl create namespace "$NS"
+
+wait_scheduled() { # name-prefix count timeout-seconds
+    local prefix=$1 want=$2 budget=$3 n
+    for _ in $(seq "$((budget / 2))"); do
+        n=$(kubectl get pods -n "$NS" \
+            -o jsonpath='{range .items[?(@.spec.nodeName)]}{.metadata.name}{"\n"}{end}' \
+            | grep -c "^$prefix" || true)
+        [ "$n" -ge "$want" ] && return 0
+        sleep 2
+    done
+    return 1
+}
+
+# -- spec 1: gang all-or-nothing (reference test/e2e gang specs) ----------
+kubectl apply -n "$NS" -f - <<'YAML'
+apiVersion: scheduling.incubator.k8s.io/v1alpha2
+kind: PodGroup
+metadata:
+  name: gang
+spec:
+  minMember: 3
+  queue: default
+YAML
+for i in 0 1 2; do
+kubectl apply -n "$NS" -f - <<YAML
+apiVersion: v1
+kind: Pod
+metadata:
+  name: gang-p$i
+  annotations:
+    scheduling.k8s.io/group-name: gang
+spec:
+  schedulerName: tpu-batch
+  containers:
+  - name: main
+    image: registry.k8s.io/pause:3.9
+    resources:
+      requests: {cpu: 100m, memory: 64Mi}
+YAML
+done
+wait_scheduled gang- 3 120 \
+    && echo "PASS: gang 3/3 scheduled" \
+    || { echo "FAIL: gang did not schedule" >&2; exit 1; }
+
+# -- spec 2: priority preemption (reference test/e2e preempt spec) --------
+# Fill the single kind node with a low-priority gang sized from its
+# allocatable CPU, then submit a high-priority gang; with the preempt
+# policy the high gang must evict and run.
+kubectl apply -f - <<'YAML'
+apiVersion: scheduling.k8s.io/v1
+kind: PriorityClass
+metadata:
+  name: e2e-high
+value: 1000
+YAML
+
+# allocatable.cpu is either bare cores ("8") or millicores ("7910m").
+RAW_CPU=$(kubectl get node -o jsonpath='{.items[0].status.allocatable.cpu}')
+case "$RAW_CPU" in
+    *m) ALLOC_MILLI=${RAW_CPU%m};;
+    *)  ALLOC_MILLI=$((RAW_CPU * 1000));;
+esac
+# Leave headroom for system pods; use 500m victims.
+VICTIMS=$(( (ALLOC_MILLI - 1500) / 500 )); [ "$VICTIMS" -ge 2 ] || VICTIMS=2
+
+kubectl apply -n "$NS" -f - <<YAML
+apiVersion: scheduling.incubator.k8s.io/v1alpha2
+kind: PodGroup
+metadata:
+  name: low
+spec:
+  minMember: $VICTIMS
+  queue: default
+YAML
+for i in $(seq 0 $((VICTIMS - 1))); do
+kubectl apply -n "$NS" -f - <<YAML
+apiVersion: v1
+kind: Pod
+metadata:
+  name: low-p$i
+  annotations:
+    scheduling.k8s.io/group-name: low
+spec:
+  schedulerName: tpu-batch
+  containers:
+  - name: main
+    image: registry.k8s.io/pause:3.9
+    resources:
+      requests: {cpu: 500m, memory: 64Mi}
+YAML
+done
+wait_scheduled low- "$VICTIMS" 120 \
+    || { echo "FAIL: low-priority gang did not schedule" >&2; exit 1; }
+
+# Switch the scheduler to the preempt policy for phase 2.
+kubectl create configmap tpu-batch-preempt-conf -n kube-system \
+    --from-literal=tpu-batch-conf.yaml="$(printf '%s\n' \
+        'actions: "preempt, allocate, backfill"' \
+        'tiers:' \
+        '- plugins:' \
+        '  - name: priority' \
+        '  - name: gang' \
+        '  - name: conformance' \
+        '- plugins:' \
+        '  - name: drf' \
+        '  - name: predicates' \
+        '  - name: proportion' \
+        '  - name: nodeorder')"
+helm upgrade tpu-batch deployment/tpu-batch --namespace kube-system \
+    --reuse-values --set scheduler.policyConfigMap=tpu-batch-preempt-conf
+kubectl rollout status -n kube-system deploy/tpu-batch --timeout=120s
+
+kubectl apply -n "$NS" -f - <<'YAML'
+apiVersion: scheduling.incubator.k8s.io/v1alpha2
+kind: PodGroup
+metadata:
+  name: high
+spec:
+  minMember: 2
+  queue: default
+  priorityClassName: e2e-high
+YAML
+for i in 0 1; do
+kubectl apply -n "$NS" -f - <<YAML
+apiVersion: v1
+kind: Pod
+metadata:
+  name: high-p$i
+  annotations:
+    scheduling.k8s.io/group-name: high
+spec:
+  schedulerName: tpu-batch
+  priorityClassName: e2e-high
+  containers:
+  - name: main
+    image: registry.k8s.io/pause:3.9
+    resources:
+      requests: {cpu: 500m, memory: 64Mi}
+YAML
+done
+wait_scheduled high- 2 180 \
+    && echo "PASS: high-priority gang preempted its way in" \
+    || { echo "FAIL: high-priority gang did not schedule" >&2; exit 1; }
+
+echo "ALL PASS"

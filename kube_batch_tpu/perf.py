@@ -120,7 +120,20 @@ def run_density(
             ),
         ))
 
+    # Scheduler first, pods second: pods ARRIVE while the scheduler
+    # runs (the kubemark flow, test/e2e/benchmark.go:49-60), so the
+    # creation timestamps the latency percentiles are measured from and
+    # the wall clock describe the same window. The committed r3
+    # artifact had wall_seconds 0.159 against e2e P50 ~2,005 ms — the
+    # old pre-load-then-start order put the benchmark's own setup time
+    # inside every pod's e2e (VERDICT r3 weakness 7).
+    sched = Scheduler(cache, scheduler_conf, schedule_period=schedule_period)
+    stop = threading.Event()
+    thread = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    thread.start()
+
     keys = []
+    start = time.time()
     groups = max(1, total_pods // max(1, pods_per_group))
     t = 0
     for g in range(groups):
@@ -141,11 +154,6 @@ def run_density(
             keys.append(f"perf/{pod.metadata.name}")
             t += 1
 
-    sched = Scheduler(cache, scheduler_conf, schedule_period=schedule_period)
-    stop = threading.Event()
-    thread = threading.Thread(target=sched.run, args=(stop,), daemon=True)
-    start = time.time()
-    thread.start()
     deadline = start + timeout
     while time.time() < deadline and not recorder.all_running(keys):
         time.sleep(0.05)
@@ -373,6 +381,9 @@ def main(argv=None):
     ap.add_argument("--min-member-frac", type=float, default=1.0)
     ap.add_argument("--period", type=float, default=0.1)
     ap.add_argument("--kubelet-delay", type=float, default=0.05)
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="total convergence budget, seconds (multitenant "
+                         "splits it between its two phases)")
     ap.add_argument("--conf", default=None, help="scheduler policy YAML path")
     ap.add_argument("--out", default=None, help="write perf JSON artifact")
     ap.add_argument(
@@ -397,6 +408,7 @@ def main(argv=None):
             pods_per_group=args.group_size,
             schedule_period=args.period,
             kubelet_delay=args.kubelet_delay,
+            timeout=args.timeout,
         )
     else:
         artifact = run_density(
@@ -407,6 +419,7 @@ def main(argv=None):
             schedule_period=args.period,
             kubelet_delay=args.kubelet_delay,
             scheduler_conf=args.conf,
+            timeout=args.timeout,
         )
     line = json.dumps(artifact)
     print(line)

@@ -59,6 +59,50 @@ class TestBestEffort:
             assert ctx.wait_tasks_ready("normal", 2)
             assert ctx.wait_tasks_ready("be", 1)
 
+    # The head-of-line scenario both resourced-backfill specs share: an
+    # elastic gang (minMember=2) whose FIRST task in task order needs
+    # 4 cpu (never fits a 3-cpu node) while its other members need 1.
+    # allocate breaks the whole job at the unfittable head ("tasks are
+    # priority-ordered: if one fails, the rest would too",
+    # allocate.go:144-148 — an assumption mixed-size jobs violate), so
+    # the placeable members and the reachable gang quorum are stranded.
+    def _headline_blocked_ctx(self, conf):
+        ctx = Context(nodes=2, node_cpu="3", node_mem="8Gi", conf=conf)
+        pods = ctx.create_job(JobSpec(
+            name="mixed", replicas=3, min_member=2,
+            req={"cpu": "1", "memory": "512Mi"},
+        ))
+        # Highest-priority member is the unplaceable one.
+        pods[0].spec.containers[0].requests = {
+            "cpu": "4", "memory": "512Mi",
+        }
+        pods[0].spec.priority = 100
+        ctx.submit(pods)
+        return ctx
+
+    def test_resourced_task_not_backfilled_by_default(self):
+        """Reference parity (backfill.go:45-49, :144-148): plain
+        `backfill` never places a task WITH a resource request, so the
+        mixed job's placeable members stay pending behind the broken
+        head task."""
+        with self._headline_blocked_ctx(DEFAULT_CONF) as ctx:
+            ctx.settle()
+            assert len(ctx.running_pods("mixed")) == 0
+
+    def test_extended_backfill_places_around_blocked_head(self):
+        """Opt-in `backfill_extended`: the placeable members fill the
+        idle capacity the broken head-of-line task stranded; the gang
+        reaches minMember=2 and dispatches. Surpasses the reference
+        TODOs at backfill.go:44 and :67-69."""
+        conf = DEFAULT_CONF.replace(
+            '"allocate, backfill"', '"allocate, backfill_extended"'
+        )
+        with self._headline_blocked_ctx(conf) as ctx:
+            assert ctx.wait_tasks_ready("mixed", 2)
+            # The 4-cpu head stays pending — backfill places only what
+            # actually fits; nothing was evicted for it.
+            assert len(ctx.running_pods("mixed")) == 2
+
 
 class TestPreemption:
     def test_preempt_for_priority(self):
