@@ -14,7 +14,7 @@ import logging
 from ..api import Resource, TaskStatus
 from ..framework import Action, register_action
 from ..utils import PriorityQueue
-from ..utils.scheduler_helper import FeasibilityMemo, get_node_list
+from ..utils.scheduler_helper import FeasibilityMemo
 
 logger = logging.getLogger(__name__)
 
