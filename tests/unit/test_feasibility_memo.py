@@ -1,0 +1,121 @@
+"""FeasibilityMemo (utils/scheduler_helper.py): the cycle-scoped
+spec-keyed feasibility cache shared by reclaim, its gang sim, and
+extended backfill. Pins the soundness rules its docstring promises."""
+
+import kube_batch_tpu.actions  # noqa: F401
+import kube_batch_tpu.plugins  # noqa: F401
+from kube_batch_tpu.api import PodPhase, build_resource_list
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.utils.scheduler_helper import FeasibilityMemo
+from kube_batch_tpu.utils.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+from tests.actions.test_actions import DEFAULT_TIERS_ARGS, make_tiers
+
+
+def _session(n_nodes=3, pods=110):
+    c = SchedulerCache(
+        binder=FakeBinder(), evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+    c.add_queue(build_queue("default"))
+    for i in range(n_nodes):
+        c.add_node(build_node(
+            f"n{i}",
+            build_resource_list(cpu="4", memory="8Gi", pods=pods),
+            labels={"zone": "a" if i == 0 else "b"},
+        ))
+    c.add_pod_group(build_pod_group("pg", namespace="ns", min_member=1))
+    return c
+
+
+def _pending(c, name, selector=None):
+    p = build_pod("ns", name, "", PodPhase.PENDING,
+                  build_resource_list(cpu="1", memory="1Gi"),
+                  group_name="pg", selector=selector)
+    c.add_pod(p)
+    return c.jobs["ns/pg"].tasks[p.metadata.uid]
+
+
+class TestFeasibilityMemo:
+    def test_equal_specs_share_one_predicate_pass(self):
+        c = _session()
+        t1 = _pending(c, "p1")
+        t2 = _pending(c, "p2")
+        ssn = open_session(c, make_tiers(*DEFAULT_TIERS_ARGS))
+        memo = FeasibilityMemo(ssn)
+        calls = {"n": 0}
+        real = ssn.predicate_fn
+
+        def counting(task, node):
+            calls["n"] += 1
+            return real(task, node)
+
+        ssn.predicate_fn = counting
+        a = memo.feasible(ssn.jobs["ns/pg"].tasks[t1.uid])
+        first = calls["n"]
+        b = memo.feasible(ssn.jobs["ns/pg"].tasks[t2.uid])
+        assert calls["n"] == first  # cache hit: zero extra predicate calls
+        assert [n.name for n in a] == [n.name for n in b]
+        close_session(ssn)
+        c.shutdown()
+
+    def test_selector_specs_do_not_cross_pollinate(self):
+        c = _session()
+        free = _pending(c, "free")
+        pinned = _pending(c, "pinned", selector={"zone": "a"})
+        ssn = open_session(c, make_tiers(*DEFAULT_TIERS_ARGS))
+        memo = FeasibilityMemo(ssn)
+        a = memo.feasible(ssn.jobs["ns/pg"].tasks[free.uid])
+        b = memo.feasible(ssn.jobs["ns/pg"].tasks[pinned.uid])
+        assert {n.name for n in a} == {"n0", "n1", "n2"}
+        assert {n.name for n in b} == {"n0"}
+        close_session(ssn)
+        c.shutdown()
+
+    def test_cached_list_refiltered_by_pod_cap(self):
+        # A node that fills up mid-cycle (pipeline adds tasks) must drop
+        # out of CACHED results: check_max_task_num is dynamic.
+        c = _session(n_nodes=2, pods=2)
+        t1 = _pending(c, "p1")
+        t2 = _pending(c, "p2")
+        ssn = open_session(c, make_tiers(*DEFAULT_TIERS_ARGS))
+        memo = FeasibilityMemo(ssn)
+        task1 = ssn.jobs["ns/pg"].tasks[t1.uid]
+        task2 = ssn.jobs["ns/pg"].tasks[t2.uid]
+        calls = {"n": 0}
+        real = ssn.predicate_fn
+
+        def counting(task, node):
+            calls["n"] += 1
+            return real(task, node)
+
+        ssn.predicate_fn = counting
+        before = memo.feasible(task1)
+        assert {n.name for n in before} == {"n0", "n1"}
+        # Fill n0 to its 2-pod cap behind the memo's back.
+        node = ssn.nodes["n0"]
+        for i in range(2):
+            filler = build_pod(
+                "ns", f"filler-{i}", "n0", PodPhase.RUNNING,
+                build_resource_list(cpu="100m", memory="64Mi"),
+            )
+            from kube_batch_tpu.api.job_info import TaskInfo
+            node.add_task(TaskInfo(filler))
+        first = calls["n"]
+        after = memo.feasible(task2)  # same spec -> cached list
+        # CACHED (no new predicate calls), yet the full node is gone:
+        # the use-time pod-cap re-filter, not a fresh pass, removed it.
+        assert calls["n"] == first
+        assert {n.name for n in after} == {"n1"}
+        close_session(ssn)
+        c.shutdown()
