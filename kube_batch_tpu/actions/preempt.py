@@ -19,6 +19,7 @@ from ..api import Resource, TaskStatus
 from ..framework import Action, register_action
 from ..utils import PriorityQueue
 from ..utils.scheduler_helper import (
+    FeasibilityMemo,
     get_node_list,
     predicate_nodes,
     prioritize_nodes,
@@ -40,11 +41,22 @@ def _validate_victims(victims, resreq: Resource) -> bool:
     return True
 
 
-def _preempt(ssn, stmt, preemptor, nodes, filter_fn) -> bool:
+def _preempt(ssn, stmt, preemptor, nodes, filter_fn, memo=None) -> bool:
     """reference preempt.go:171-254"""
     assigned = False
-    all_nodes = get_node_list(nodes)
-    fit_nodes = predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
+    if memo is not None:
+        # Cycle-scoped spec-keyed feasibility (same throughput reasoning
+        # as reclaim's: preemptors re-scan every node per attempt, and a
+        # starving backlog shares a handful of pod specs). Preempt's
+        # predicate pass is pure ssn.predicate_fn — no resource-fit
+        # term, victims are expected to free the resources — so the
+        # memo's verdict-staleness rules apply unchanged; statement
+        # rollbacks only REMOVE node tasks, which the memo's
+        # conservative direction tolerates.
+        fit_nodes = memo.feasible(preemptor)
+    else:
+        all_nodes = get_node_list(nodes)
+        fit_nodes = predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
     priority_list = prioritize_nodes(
         preemptor, fit_nodes, ssn.node_prioritizers()
     )
@@ -121,6 +133,8 @@ class PreemptAction(Action):
                 for task in job.task_status_index[TaskStatus.PENDING].values():
                     preemptor_tasks[job.uid].push(task)
 
+        memo = FeasibilityMemo(ssn)
+
         # Phase 1: preemption between jobs within a queue (preempt.go:76-135).
         for queue in queues.values():
             while True:
@@ -146,7 +160,8 @@ class PreemptAction(Action):
                             job.queue == _job.queue and _preemptor.job != task.job
                         )
 
-                    if _preempt(ssn, stmt, preemptor, ssn.nodes, filter_fn):
+                    if _preempt(ssn, stmt, preemptor, ssn.nodes,
+                                filter_fn, memo=memo):
                         assigned = True
                     if ssn.job_pipelined(preemptor_job):
                         stmt.commit()
@@ -179,6 +194,7 @@ class PreemptAction(Action):
                         task.status == TaskStatus.RUNNING
                         and _p.job == task.job
                     ),
+                    memo=memo,
                 )
                 stmt.commit()
                 if not assigned:
