@@ -277,10 +277,20 @@ class JobInfo:
             if bucket is not None and len(bucket) == len(tasks):
                 stored_get = self.tasks.get
                 uniform = True
+                seen = set()
                 for t in tasks:
-                    if t.status is not src_status or stored_get(t.uid) is not t:
+                    # The identity check makes uid-uniqueness ≡ object
+                    # identity, so dedupe on id(): a duplicate-bearing
+                    # list ([a, a] vs bucket {a, b}) would otherwise
+                    # pass the length test, drag b along without a
+                    # status write, and double-count a's resreq on a
+                    # flipping transition.
+                    if (t.status is not src_status
+                            or stored_get(t.uid) is not t
+                            or id(t) in seen):
                         uniform = False
                         break
+                    seen.add(id(t))
                 if uniform:
                     validate_status_update(src_status, status)
                     was = allocated_status(src_status)

@@ -213,6 +213,14 @@ class SchedulerCache(Cache, EventHandlersMixin):
         def wrapped():
             try:
                 fn()
+            except Exception:
+                # A side-effect job's Future is never read, so an
+                # escaping exception would otherwise vanish — and for
+                # bookkeeping jobs that means tasks already bulk-moved
+                # to BINDING silently stay there. Log loudly; the
+                # per-task revert/resync paths inside the job are the
+                # real recovery, this is the backstop.
+                logger.exception("side-effect job failed")
             finally:
                 with self._inflight_cond:
                     self._inflight -= 1
@@ -514,7 +522,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
     # (b) all workers share the bind backlog.
     _BIND_CHUNK = 1024
 
-    def bind_batch(self, task_infos) -> list:
+    def bind_batch(self, task_infos, on_accepted=None) -> list:
         """Batched :meth:`bind`, fully asynchronous: the cache-mirror
         bookkeeping AND the bind side effects run on the side-effect
         pool, overlapping the scheduler's remaining cycle and its
@@ -531,13 +539,21 @@ class SchedulerCache(Cache, EventHandlersMixin):
         assume-then-resync bind (cache.go:480-522)."""
         infos = list(task_infos)
         if not infos:
+            if on_accepted is not None:
+                try:
+                    on_accepted(infos)
+                except Exception:  # same contract as the async path
+                    logger.exception(
+                        "bind_batch on_accepted callback failed"
+                    )
             return infos
         self._submit_side_effect(
-            lambda: self._bind_batch_bookkeeping(infos), bookkeeping=True
+            lambda: self._bind_batch_bookkeeping(infos, on_accepted),
+            bookkeeping=True,
         )
         return infos
 
-    def _bind_batch_bookkeeping(self, task_infos) -> list:
+    def _bind_batch_bookkeeping(self, task_infos, on_accepted=None) -> list:
         """Under-mutex half of bind_batch + side-effect submission.
         Runs on the side-effect pool. Per-task semantics are bind()'s:
         validation failures are logged and skipped, side-effect failures
@@ -592,10 +608,48 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 (slow_binds if may_wait else binds).append(item)
                 bound.append(ti)
 
+            def revert(ti, stored, job, prior, hostname, why):
+                # The per-task bind() path surfaces a node rejection to
+                # its caller by raising; here the caller is gone by
+                # side-effect time, so a silently dropped task would sit
+                # in BINDING with node_name set and no resync until an
+                # external pod event. Revert the staged bookkeeping so
+                # the task is schedulable again next cycle.
+                prior_status, prior_node = prior
+                try:
+                    job.update_task_status(stored, prior_status)
+                    stored.node_name = prior_node
+                    # Drop the claim assumptions made at allocate time,
+                    # like the per-task failure path (_bind_side_effect)
+                    # — a stale assumption on the rejected host would
+                    # fail every future placement of this task.
+                    if stored.pod.spec.volume_claims:
+                        self.volume_binder.release_volumes(stored)
+                except Exception:
+                    logger.exception(
+                        "failed to revert %s bind %s/%s; resyncing",
+                        why, ti.namespace, ti.name,
+                    )
+                    self._resync_task(stored.clone())
+                logger.warning(
+                    "node %s %s staged bind of %s/%s; reverted to %s",
+                    hostname, why, ti.namespace, ti.name,
+                    prior_status.name,
+                )
+
             # Node accounting grouped per node (one aggregate idle/used
             # update; fallback policy in NodeInfo.add_tasks_with_fallback).
             for hostname, items in staged.items():
-                node = self.nodes[hostname]
+                node = self.nodes.get(hostname)
+                if node is None:
+                    # A node-delete watch event can land in the async
+                    # window between dispatch and bookkeeping. Treat the
+                    # whole group as rejected — same revert path — so
+                    # the batch's remaining groups still proceed.
+                    for ti, stored, job, prior in items:
+                        revert(ti, stored, job, prior, hostname,
+                               "vanished under")
+                    continue
                 ok = {
                     id(s) for s in node.add_tasks_with_fallback(
                         [stored for _, stored, _, _ in items]
@@ -605,35 +659,8 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     if id(stored) in ok:
                         accept(ti, stored, hostname)
                     else:
-                        # The per-task bind() path surfaces a node
-                        # rejection to its caller by raising; here the
-                        # caller is gone by side-effect time, so a
-                        # silently dropped task would sit in BINDING with
-                        # node_name set and no resync until an external
-                        # pod event. Revert the staged bookkeeping so the
-                        # task is schedulable again next cycle.
-                        prior_status, prior_node = prior
-                        try:
-                            job.update_task_status(stored, prior_status)
-                            stored.node_name = prior_node
-                            # Drop the claim assumptions made at
-                            # allocate time, like the per-task failure
-                            # path (_bind_side_effect) — a stale
-                            # assumption on the rejected host would
-                            # fail every future placement of this task.
-                            if stored.pod.spec.volume_claims:
-                                self.volume_binder.release_volumes(stored)
-                        except Exception:
-                            logger.exception(
-                                "failed to revert rejected bind %s/%s; "
-                                "resyncing", ti.namespace, ti.name,
-                            )
-                            self._resync_task(stored.clone())
-                        logger.warning(
-                            "node %s rejected staged bind of %s/%s; "
-                            "reverted to %s", hostname, ti.namespace,
-                            ti.name, prior_status.name,
-                        )
+                        revert(ti, stored, job, prior, hostname,
+                               "rejected")
 
         # Pre-warm the COW snapshot pool for everything this batch
         # dirtied: re-clone the touched jobs/nodes HERE, on the
@@ -674,6 +701,11 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     lambda p=pod, h=hostname, s=task_snapshot:
                         self._bind_side_effect(p, h, s)
                 )
+        if on_accepted is not None:
+            try:
+                on_accepted(bound)
+            except Exception:
+                logger.exception("bind_batch on_accepted callback failed")
         return bound
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:

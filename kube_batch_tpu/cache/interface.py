@@ -74,11 +74,15 @@ class Cache(ABC):
     @abstractmethod
     def bind(self, task: "TaskInfo", hostname: str) -> None: ...
 
-    def bind_batch(self, task_infos) -> list:
+    def bind_batch(self, task_infos, on_accepted=None) -> list:
         """Batched bind (TPU-native extension): one bookkeeping pass + one
         async side-effect job for a whole gang. Default falls back to
         per-task bind(); SchedulerCache overrides with the real batch.
-        Each task must carry node_name. Returns tasks accepted."""
+        Each task must carry node_name. Returns the tasks submitted;
+        ``on_accepted`` (if given) is invoked — possibly later, from a
+        worker thread — with the subset whose cache bookkeeping actually
+        succeeded, so callers can observe per-task metrics without
+        counting validation failures or node-rejected reverts."""
         bound = []
         for ti in task_infos:
             try:
@@ -88,6 +92,11 @@ class Cache(ABC):
                 logger.exception(
                     "failed to bind task %s/%s", ti.namespace, ti.name
                 )
+        if on_accepted is not None:
+            try:
+                on_accepted(bound)
+            except Exception:  # same contract as the async batch path
+                logger.exception("bind_batch on_accepted callback failed")
         return bound
 
     @abstractmethod

@@ -372,12 +372,21 @@ class Session:
                 ready.append(task)
             _move_tasks_logged(job, ready, TaskStatus.BINDING)
             all_ready.extend(ready)
-        self.cache.bind_batch(all_ready)
+        # Latency is measured creation → dispatch (reference
+        # session.go:316), so capture `now` here; but observe only the
+        # tasks whose cache bookkeeping ACCEPTED the bind (the callback
+        # fires from the bookkeeping worker), so validation failures and
+        # node-rejected reverts don't inflate scheduled counts.
         now = _time.time()
-        metrics.update_task_schedule_durations([
-            max(0.0, now - t.pod.metadata.creation_timestamp)
-            for t in all_ready
-        ])
+        self.cache.bind_batch(
+            all_ready,
+            on_accepted=lambda accepted: (
+                metrics.update_task_schedule_durations([
+                    max(0.0, now - t.pod.metadata.creation_timestamp)
+                    for t in accepted
+                ])
+            ),
+        )
 
     def dispatch(self, task: TaskInfo) -> None:
         """Bind one gang member (reference session.go:294-318)."""

@@ -11,15 +11,22 @@ The Go reference pays this as concurrent GC; CPython stops the world.
 disabled for the duration of the cycle and a bounded young-generation
 collection runs on exit — in the scheduler's think-time gap, where a
 pause costs nothing. Nesting is safe (only the outermost guard
-re-enables); an exception still restores GC.
+re-enables); an exception still restores GC. GC state is process-wide,
+so the guard is too: a lock serializes the depth/enable bookkeeping and
+the OUTERMOST enter records whether GC was on, so concurrent guards
+from different threads (e.g. scheduler cycle + side-effect worker)
+cannot strand GC disabled.
 """
 
 from __future__ import annotations
 
 import gc
+import threading
 from contextlib import contextmanager
 
+_lock = threading.Lock()
 _depth = 0
+_outer_was_enabled = False
 
 
 @contextmanager
@@ -28,16 +35,30 @@ def deferred_gc(collect_generation: int = 1):
     ``gc.collect(collect_generation)`` (default: young+middle
     generations — bounded, does not scan the full mirror). Pass -1 to
     skip the exit collection entirely."""
-    global _depth
-    _depth += 1
-    was_enabled = gc.isenabled()
-    if was_enabled:
-        gc.disable()
+    global _depth, _outer_was_enabled
+    with _lock:
+        if _depth == 0:
+            _outer_was_enabled = gc.isenabled()
+            if _outer_was_enabled:
+                gc.disable()
+        _depth += 1
     try:
         yield
     finally:
-        _depth -= 1
-        if was_enabled and _depth == 0:
-            gc.enable()
-            if collect_generation >= 0:
+        collect = False
+        with _lock:
+            _depth -= 1
+            if _depth == 0 and _outer_was_enabled:
+                gc.enable()
+                collect = collect_generation >= 0
+        # Collect outside the lock: the exit collection can take tens
+        # of ms and must not block another thread's guard entry. But if
+        # another thread entered a guard in the window after we released
+        # the lock, collecting now would stop the world inside ITS
+        # supposedly GC-free cycle — re-check depth and let that
+        # thread's own exit do the collection instead.
+        if collect:
+            with _lock:
+                collect = _depth == 0
+            if collect:
                 gc.collect(collect_generation)

@@ -329,6 +329,64 @@ class TestSideEffects:
         key = c.binder.channel.get(timeout=3)
         assert key == f"ns/{accepted.name}"
 
+    def test_bind_batch_reverts_when_node_deleted_mid_flight(self):
+        # A node-delete watch event can land in the async window between
+        # dispatch and the deferred bookkeeping. The whole staged group
+        # for that hostname must revert (not KeyError out and strand the
+        # rest of the batch in BINDING with no log and no resync).
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list(cpu="4", memory="8Gi")))
+        c.add_node(build_node("n2", build_resource_list(cpu="4", memory="8Gi")))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=2))
+        pods = [
+            build_pod("ns", f"p{i}", "", PodPhase.PENDING, req(),
+                      group_name="pg1")
+            for i in range(2)
+        ]
+        for p in pods:
+            c.add_pod(p)
+        tasks = [c.jobs["ns/pg1"].tasks[p.metadata.uid] for p in pods]
+        infos = [t.clone() for t in tasks]
+        infos[0].node_name = "n1"   # this node will vanish
+        infos[1].node_name = "n2"   # this group must still bind
+        for info in infos:
+            info.volume_ready = True
+
+        del c.nodes["n1"]  # simulate the delete landing first
+        c.bind_batch(infos)
+        assert c.wait_for_bookkeeping(timeout=10)
+        assert tasks[0].status == TaskStatus.PENDING
+        assert tasks[0].node_name == ""
+        assert tasks[1].status == TaskStatus.BINDING
+        assert c.nodes["n2"].used.milli_cpu == 1000
+        key = c.binder.channel.get(timeout=3)
+        assert key == "ns/p1"
+
+    def test_bind_batch_on_accepted_sees_only_accepted(self):
+        # Metrics hook: the callback fires with the subset whose
+        # bookkeeping succeeded, not everything dispatched.
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list(cpu="1", memory="1Gi")))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=2))
+        pods = [
+            build_pod("ns", f"p{i}", "", PodPhase.PENDING, req(),
+                      group_name="pg1")
+            for i in range(2)
+        ]
+        for p in pods:
+            c.add_pod(p)
+        infos = [
+            c.jobs["ns/pg1"].tasks[p.metadata.uid].clone() for p in pods
+        ]
+        for info in infos:
+            info.node_name = "n1"  # only one cpu fits
+            info.volume_ready = True
+        seen = []
+        c.bind_batch(infos, on_accepted=lambda acc: seen.append(list(acc)))
+        assert c.wait_for_bookkeeping(timeout=10)
+        assert len(seen) == 1
+        assert len(seen[0]) == 1  # one accepted, one node-rejected
+
     def test_bind_batch_prewarns_snapshot_pool(self):
         # The deferred bookkeeping re-clones the jobs/nodes it dirtied
         # into the COW pool, so the NEXT snapshot reuses those clones

@@ -1,0 +1,49 @@
+"""deferred_gc: GC state is process-wide, so concurrent guards from
+different threads must never strand GC disabled (advisor r4 finding)."""
+
+import gc
+import threading
+
+from kube_batch_tpu.utils.gc_guard import deferred_gc
+
+
+def test_nested_reenables_only_at_outermost():
+    assert gc.isenabled()
+    with deferred_gc(collect_generation=-1):
+        assert not gc.isenabled()
+        with deferred_gc(collect_generation=-1):
+            assert not gc.isenabled()
+        assert not gc.isenabled()  # inner exit must not re-enable
+    assert gc.isenabled()
+
+
+def test_exception_restores_gc():
+    try:
+        with deferred_gc(collect_generation=-1):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert gc.isenabled()
+
+
+def test_concurrent_guards_do_not_strand_gc_disabled():
+    # Two threads overlap their guards in every interleaving the
+    # barriers can force; GC must be enabled once both exit.
+    assert gc.isenabled()
+    inside = threading.Barrier(3, timeout=10)  # 2 workers + main
+    release = threading.Event()
+
+    def worker():
+        with deferred_gc(collect_generation=-1):
+            inside.wait()      # both threads hold a guard concurrently
+            release.wait(10)   # first exiter leaves while other holds
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    inside.wait()
+    assert not gc.isenabled()
+    release.set()
+    for t in threads:
+        t.join(10)
+    assert gc.isenabled()

@@ -118,6 +118,28 @@ class TestJobInfo:
         job.update_task_status(tasks[0], TaskStatus.FAILED)
         assert job.valid_task_num() == 1
 
+    def test_bulk_update_duplicate_tasks_not_merged_as_bucket(self):
+        # [a, a] vs bucket {a, b} passes the length test; the fast path
+        # must still reject it, or b gets dragged to the target bucket
+        # without a status write and a's resreq double-counts on a
+        # flipping transition.
+        a, b = mk_task("a"), mk_task("b")
+        job = JobInfo("ns/pg1", a, b)
+        job.update_tasks_status([a, a], TaskStatus.ALLOCATED)
+        assert a.status == TaskStatus.ALLOCATED
+        assert b.status == TaskStatus.PENDING
+        assert b.uid in job.task_status_index[TaskStatus.PENDING]
+        assert b.uid not in job.task_status_index[TaskStatus.ALLOCATED]
+        assert job.allocated.milli_cpu == 1000  # a counted once
+
+    def test_bulk_update_whole_bucket_fast_path(self):
+        tasks = [mk_task(f"t{i}") for i in range(3)]
+        job = JobInfo("ns/pg1", *tasks)
+        job.update_tasks_status(list(tasks), TaskStatus.ALLOCATED)
+        assert TaskStatus.PENDING not in job.task_status_index
+        assert all(t.status == TaskStatus.ALLOCATED for t in tasks)
+        assert job.allocated.milli_cpu == 3000
+
     def test_clone_is_deep(self):
         t1 = mk_task("t1")
         job = JobInfo("ns/pg1", t1)
