@@ -167,7 +167,7 @@ def bench_native_greedy(inputs, repeats=2):
         from kube_batch_tpu.native import NativeUnavailable, greedy_allocate
     except Exception:
         return None
-    solver_in = inputs.unpack()
+    solver_in = inputs.unpack() if hasattr(inputs, "unpack") else inputs
     task_req = np.asarray(solver_in.task_req)
     valid = np.asarray(solver_in.task_valid)
     task_req = task_req[valid]
@@ -259,6 +259,10 @@ def bench_tpu(cfg, seed=0, repeats=3):
         "rounds": rounds,
         "work": n_tasks * n_nodes,
         "inputs": inputs,
+        # NumPy-backed SolverInputs for the native baselines — feeding
+        # them the device PackedInputs would bill ~140 ms of eager JAX
+        # slicing to a C++ loop (r4 delta-profile lesson).
+        "host_inputs": ctx.host_inputs,
         # Every task is still Pending (the solve was never applied):
         # bench_cycle reuses this cluster instead of rebuilding it.
         "cache": cache,
@@ -393,7 +397,7 @@ def main():
     # vs_baseline: measured NATIVE reference loop at the headline scale
     # (the honest Go-loop stand-in); falls back to the O(T*N)-extrapolated
     # Python greedy when no native toolchain exists.
-    native = bench_native_greedy(tpu["inputs"])
+    native = bench_native_greedy(tpu["host_inputs"])
     headline_work = CONFIGS[headline_cfg][0] * CONFIGS[headline_cfg][1]
     greedy_extrapolated_s = greedy_s * headline_work / greedy_work
     extra = {}
@@ -439,7 +443,7 @@ def main():
         # No accelerator: the framework's production path is the native
         # masked loop (allocate_tpu routes there), so THAT is the honest
         # headline; the batched-kernel CPU time is kept as a side metric.
-        masked = bench_native_masked(tpu["inputs"])
+        masked = bench_native_masked(tpu["host_inputs"])
         if masked is not None:
             masked_s, masked_placed = masked
             headline_ms = masked_s * 1e3

@@ -97,14 +97,20 @@ class AllocateTpuAction(Action):
         # block, metrics) must see an empty dict, not the previous
         # cycle's timings attributed to the failed cycle.
         last_stats.clear()
+        # Backend decision BEFORE tensorize: the native CPU path consumes
+        # the host NumPy arrays directly (device=False), skipping the
+        # host→device pack and the per-field eager slices of unpack() —
+        # together ~180 ms of the 50k delta cycle (r4/r5 profiles) spent
+        # shuttling data through JAX for a solve that runs in C++.
+        use_native = _use_native_solver()
         t0 = time.perf_counter()
-        inputs, ctx = tensorize(ssn)
+        inputs, ctx = tensorize(ssn, device=not use_native)
         _record_phase("tensorize", (time.perf_counter() - t0) * 1e3)
         if inputs is None:
             return
 
         t0 = time.perf_counter()
-        if _use_native_solver():
+        if use_native:
             from ..native import solve_native
 
             assigned, _ = solve_native(inputs)

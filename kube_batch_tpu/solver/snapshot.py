@@ -97,6 +97,12 @@ class SnapshotContext:
     task_fit_host: Optional[np.ndarray] = None
     task_req_host: Optional[np.ndarray] = None
     node_idle_host: Optional[np.ndarray] = None
+    # NumPy-backed SolverInputs (same padded arrays that feed the device
+    # pack). The native CPU solver consumes THIS — slicing fields out of
+    # the device PackedInputs costs an eager XLA dispatch per field
+    # (~140 ms of the 50 k delta cycle, r4 profile) for data that never
+    # needed to leave the host.
+    host_inputs: Optional[object] = None
 
 
 def _sorted_by(items, less_fn):
@@ -142,18 +148,28 @@ def _pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
-def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
-    """Build `(SolverInputs, SnapshotContext)` for the session's pending,
+def tensorize(
+    ssn,
+    include_jobs: Optional[List[JobInfo]] = None,
+    pad=True,
+    device=True,
+):
+    """Build `(inputs, SnapshotContext)` for the session's pending,
     non-best-effort tasks, or ``(None, None)`` if there is nothing to solve.
 
     ``include_jobs`` restricts the task set (used by tests and by actions
     that solve for a subset). With ``pad`` (default), array shapes are
     rounded up to buckets (padded tasks/nodes are marked invalid) so a
     long-running scheduler re-jits only when the cluster crosses a bucket
-    boundary, not on every snapshot."""
-    import jax.numpy as jnp
+    boundary, not on every snapshot.
 
-    from .kernels import PackedInputs
+    With ``device`` (default), ``inputs`` is a :class:`PackedInputs` of
+    stacked device buffers for the JAX kernel. With ``device=False`` —
+    the native-CPU-solver path — the jnp packing is skipped entirely and
+    ``inputs`` is the NumPy-backed :class:`SolverInputs` (also always
+    available as ``ctx.host_inputs``): no host→device copies, no eager
+    per-field XLA slices on a path that never runs on an accelerator."""
+    from .kernels import PackedInputs, SolverInputs
     from .masks import combine_masks, combine_score_rows
 
     nodes = [n for n in ssn.nodes.values() if n.ready()]
@@ -420,10 +436,48 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
     lr_w = float(weights.get("leastrequested", 0.0))
     br_w = float(weights.get("balancedresource", 0.0))
 
+    # NumPy-backed SolverInputs: what the native CPU solver consumes, and
+    # the source arrays for the device pack below.
+    host_inputs = SolverInputs(
+        task_req=task_req,
+        task_fit=task_fit,
+        task_rank=task_rank,
+        task_job=task_job,
+        task_queue=task_queue,
+        task_valid=task_valid,
+        task_group=task_group,
+        node_feas=node_feas,
+        group_feas=group_feas,
+        pair_idx=pair_idx,
+        pair_feas=pair_feas,
+        score_idx=score_idx,
+        score_rows=score_rows,
+        node_idle=node_idle,
+        node_releasing=node_releasing,
+        node_cap=node_cap,
+        node_task_count=node_task_count,
+        node_max_tasks=node_max_tasks,
+        queue_deserved=queue_deserved,
+        queue_allocated=queue_allocated,
+        eps=layout.eps(),
+        lr_weight=np.float32(lr_w),
+        br_weight=np.float32(br_w),
+    )
+    ctx = SnapshotContext(
+        layout, tasks, nodes, queue_order, mask,
+        task_fit_host=fit_mat[order], task_req_host=req_mat[order],
+        node_idle_host=node_idle64,
+        host_inputs=host_inputs,
+    )
+    if not device:
+        return host_inputs, ctx
+
     # Pack the host→device copies: each device_put is a host↔accelerator
     # round trip (expensive over a tunneled TPU) and each eager device op
     # compiles a tiny XLA program, so ship a few stacked buffers;
     # kernels.solve unpacks them INSIDE the jit (PackedInputs.unpack).
+    import jax.numpy as jnp
+
     inputs = PackedInputs(
         task_f32=jnp.asarray(np.stack([task_req, task_fit])),
         task_i32=jnp.asarray(np.stack([
@@ -445,10 +499,5 @@ def tensorize(ssn, include_jobs: Optional[List[JobInfo]] = None, pad=True):
         misc=jnp.asarray(np.concatenate([
             layout.eps(), [lr_w, br_w]
         ]).astype(np.float32)),
-    )
-    ctx = SnapshotContext(
-        layout, tasks, nodes, queue_order, mask,
-        task_fit_host=fit_mat[order], task_req_host=req_mat[order],
-        node_idle_host=node_idle64,
     )
     return inputs, ctx
