@@ -33,6 +33,7 @@ from .sharding import (
     solve_sharded,
 )
 from .snapshot import ResourceLayout, SnapshotContext, tensorize
+from .spmd import solve_spmd, spmd_shardings_for
 
 __all__ = [
     "PackedInputs",
@@ -60,6 +61,8 @@ __all__ = [
     "solve_full_jit",
     "solve_jit",
     "solve_sharded",
+    "solve_spmd",
+    "spmd_shardings_for",
     "solve_staged",
     "solve_staged_jit",
     "tensorize",

@@ -164,18 +164,50 @@ def _sharded_step(mesh: Mesh, shardings, staged, max_rounds, tail_bucket):
     )
 
 
+def _staged_for_shape(inputs, staged):
+    """Resolve the ``staged=None`` shape dispatch (solve_auto's rule)
+    statically so both sharded implementations pick the same solver."""
+    if staged is not None:
+        return staged
+    from .kernels import _STAGED_MIN_NODES, _STAGED_MIN_TASKS
+
+    if isinstance(inputs, PackedInputs):
+        T, N = inputs.task_f32.shape[1], inputs.node_f32.shape[1]
+    else:
+        T, N = inputs.task_req.shape[0], inputs.node_idle.shape[0]
+    return N >= _STAGED_MIN_NODES and T >= _STAGED_MIN_TASKS
+
+
 def sharded_step(
     inputs,
     mesh: Mesh,
     max_rounds: int = 256,
     staged=None,
     tail_bucket: int = 3072,
+    impl: str = "spmd",
 ):
     """Return ``(step_fn, device_inputs)``: inputs padded and device_put
     onto the mesh ONCE, plus the cached jitted step to run on them. Use
     this when solving the same snapshot repeatedly (benchmarks, re-solve
-    loops) so the host→device transfer is not re-paid per call."""
+    loops) so the host→device transfer is not re-paid per call.
+
+    ``impl='spmd'`` (default) is the hierarchical shard_map solver
+    (solver/spmd.py): node columns sharded, node/queue tables
+    replicated, per-commit communication limited to a two-[T]-vector
+    all_gather. ``impl='gspmd'`` keeps the legacy auto-partitioned
+    single-device program (collective-dominated at scale; retained for
+    A/B and as the fallback surface)."""
     inputs = pad_nodes(inputs, mesh.size)
+    if impl == "spmd":
+        from .spmd import _spmd_step, spmd_shardings_for
+
+        shardings = spmd_shardings_for(inputs, mesh)
+        inputs = jax.device_put(inputs, shardings)
+        step = _spmd_step(
+            mesh, _staged_for_shape(inputs, staged), max_rounds,
+            tail_bucket,
+        )
+        return step, inputs
     shardings = shardings_for(inputs, mesh)
     inputs = jax.device_put(inputs, shardings)
     step = _sharded_step(mesh, shardings, staged, max_rounds, tail_bucket)
@@ -188,6 +220,7 @@ def solve_sharded(
     max_rounds: int = 256,
     staged=None,
     tail_bucket: int = 3072,
+    impl: str = "spmd",
 ):
     """Run the batched solve with the node axis sharded over ``mesh``.
 
@@ -195,7 +228,9 @@ def solve_sharded(
     forces the staged solver, False the full-width one. Falls back to the
     single-device jitted path when no mesh is available. Same semantics
     and results as the single-device solve — sharding changes layout, not
-    the program.
+    the program. ``impl`` selects the hierarchical shard_map solver
+    (default) or the legacy GSPMD auto-partitioning (see
+    :func:`sharded_step`).
     """
     if mesh is None:
         mesh = default_mesh()
@@ -213,6 +248,6 @@ def solve_sharded(
 
     step, inputs = sharded_step(
         inputs, mesh, max_rounds=max_rounds, staged=staged,
-        tail_bucket=tail_bucket,
+        tail_bucket=tail_bucket, impl=impl,
     )
     return step(inputs)

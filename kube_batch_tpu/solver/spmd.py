@@ -1,0 +1,705 @@
+"""Explicit-SPMD sharded solve: hierarchical conflict resolution.
+
+The GSPMD path (sharding.py) annotates the single-device program and lets
+XLA partition it. That is correct but collective-dominated at scale: the
+per-commit global argmax over a node-sharded [T, N] key matrix and the
+scatter that voids lost columns make GSPMD materialize cross-shard
+gathers of [T, N]-sized intermediates — measured 1.6x SLOWER than
+single-device at 10k x 1001 on the 8-device CPU mesh (MULTICHIP_r04).
+
+This module instead writes the SPMD program explicitly with `shard_map`,
+restructuring conflict resolution hierarchically (VERDICT r4 item 2):
+
+- LOCAL bid: each shard owns N/s node columns. The O(T*N) work — fit
+  mask, dynamic scores, integer bid keys, per-task argmax — runs on the
+  local [T, N/s] block only. Each shard reduces to TWO [T] vectors: its
+  best key and best local node per task.
+- GLOBAL reconcile: one `all_gather` ships those [T] vectors (s * T * 8
+  bytes total — NOT [T, N]); every shard then computes the same global
+  winner per task. Ties break toward the lowest shard then lowest local
+  column, which is exactly the single-device argmax's first-max rule, so
+  placement parity is bit-exact.
+- SHARD-0 commit: node idle/task-count and queue budget tables are tiny
+  (O(N*R), O(Q*R)) and kept replicated as VALUES, but the sort-based
+  `_commit_bids` itself runs on shard 0 only, which psum-broadcasts its
+  packed result (zeros from the other shards). Replicated commit
+  compute would be free on real parallel chips but multiplies wall time
+  by the shard count on an oversubscribed/emulated mesh — measured
+  +0.28 s/device/solve at 10k x 1001. Only the shard that OWNS a lost
+  bidder's column voids it locally.
+
+Per commit the only communication is one packed candidate all_gather
+and one packed psum broadcast (the pool style amortizes both to once
+per ROUND — see `_spmd_round`). Everything else is either node-local or
+replicated. On real hardware these collectives ride ICI (scaling-book
+recipe: shard the big axis, gather only reductions); on the 1-core
+virtual CPU mesh the shards serialize, so the honest target there is
+parity with single-device, not speedup — the win is that the sharded
+program does no more TOTAL work than the single-device one, which the
+GSPMD version could not achieve.
+
+Reference analog being replaced: the 16-worker PredicateNodes fan-out,
+util/scheduler_helper.go:84,137 — itself a shard-the-node-axis design.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8 moved shard_map out of experimental
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=True):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_rep,
+        )
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from .kernels import (
+    PackedInputs,
+    SolverInputs,
+    SolverResult,
+    _commit_bids,
+    _dyn_score_core,
+    CPU_DIM,
+    MEM_DIM,
+    COMMITS_PER_ROUND,
+    bid_keys,
+    less_equal,
+    segmented_cummin,
+)
+
+NODE_AXIS = "nodes"
+
+# SolverInputs fields carrying node COLUMNS (sharded); node TABLES
+# (idle/cap/releasing/counts) stay replicated — they are O(N*R) small and
+# the replicated commit updates them identically on every shard.
+_SHARDED_SPECS = {
+    "node_feas": P(NODE_AXIS),
+    "group_feas": P(None, NODE_AXIS),
+    "pair_feas": P(None, NODE_AXIS),
+    "score_rows": P(None, NODE_AXIS),
+}
+
+INT_MAX = 2**31 - 1
+
+
+def spmd_shardings_for(inputs, mesh: Mesh):
+    """Device-put layout for the hierarchical solver: node COLUMN fields
+    sharded over the mesh, node/queue tables and task vectors replicated.
+    (PackedInputs stacks node tables with the feas column in node_i32, so
+    its node buffers stay replicated; shard_map lays the unpacked
+    node_feas out per-shard at trace time.)"""
+    from jax.sharding import NamedSharding
+
+    rep = NamedSharding(mesh, P())
+    cls = type(inputs)
+    if isinstance(inputs, PackedInputs):
+        minor = NamedSharding(mesh, P(None, NODE_AXIS))
+        sharded = {"group_feas", "pair_feas", "score_rows"}
+        return cls(**{
+            f: minor if f in sharded else rep for f in cls._fields
+        })
+    return cls(**{
+        f: NamedSharding(mesh, _SHARDED_SPECS[f])
+        if f in _SHARDED_SPECS else rep
+        for f in cls._fields
+    })
+
+
+def _local_feasibility(inputs, n_local, valid):
+    """[T, N/s] static predicate mask from the shard's local columns
+    (local form of kernels.build_feasibility)."""
+    T = inputs.task_req.shape[0]
+    feas = (
+        inputs.group_feas[inputs.task_group]
+        & inputs.node_feas[None, :]
+        & valid[:, None]
+    )
+    Pn = inputs.pair_idx.shape[0]
+    if Pn:
+        ext = jnp.ones((T + 1, n_local), bool).at[inputs.pair_idx].set(
+            inputs.pair_feas
+        )
+        feas = feas & ext[:T]
+    return feas
+
+
+def _local_static_score(inputs, n_local):
+    """[T, N/s] static score block (local build_static_score)."""
+    T = inputs.task_req.shape[0]
+    S = inputs.score_idx.shape[0]
+    if not S:
+        return jnp.zeros((), jnp.float32)
+    ext = jnp.zeros((T + 1, n_local), jnp.float32).at[
+        inputs.score_idx
+    ].add(inputs.score_rows)
+    return ext[:T]
+
+
+# Round style dispatch: the candidate-pool round pays one fixed
+# [T, N/s] top-C extraction per round (then commits touch only the tiny
+# pool), the per-commit round re-argmaxes [T, N/s] per commit but skips
+# the extraction. Measured crossover on the 8-device mesh: pool wins for
+# compacted-tail-sized task blocks, per-commit wins at full width.
+_POOL_MAX_T = 4096
+
+
+def _spmd_round(
+    assigned, idle, ntask, qalloc, failed,
+    *, task_req, task_fit, task_rank, task_queue, task_sel, task_ids,
+    feas_l, static_l, fits_releasing, blocked_of,
+    node_cap, node_max_tasks, queue_deserved,
+    lr_weight, br_weight, eps, n_off, n_local, style,
+):
+    """One solver round, hierarchical. Mirrors kernels._solve_round's
+    semantics exactly (same gating, same job-break rule, same multi-
+    commit cascade) with bit-exact placement parity.
+
+    Shared structure: the O(T*N) work — fit mask, dynamic scores,
+    integer bid keys — builds on the LOCAL [T, N/s] column block; the
+    sort-based conflict-resolution commit runs on shard 0 only against
+    replicated node/queue tables and psum-broadcasts its packed result
+    (running it replicated would be free on real parallel chips but
+    multiplies wall time by the shard count on an oversubscribed/
+    emulated mesh — measured +0.28 s/device/solve at 10k x 1001).
+
+    ``style`` picks the reconcile cadence:
+
+    - ``"pool"``: extract each shard's top-(commits+1) candidates once
+      per round by iterative argmax+void, gather them in ONE collective,
+      and run every commit against the [s*(commits+1), T] pool — 2
+      collectives per round. Within a round voids only remove commit
+      winners, which by construction sit at the top of their shard's
+      list, so after <= COMMITS_PER_ROUND voids the true global argmax
+      always remains inside the pool: exact equivalence with the
+      full-matrix re-argmax.
+    - ``"commit"``: re-argmax the local block per commit and reconcile
+      with one packed two-[T]-vector gather per commit (2 collectives
+      per commit, but no extraction pass). The job-break verdict folds
+      into the first commit's gather.
+
+    Row-level gates (task_ok, job-block) are applied at bid time, which
+    is equivalent to masking rows before the argmax because both are
+    row-independent.
+    """
+    N = idle.shape[0]
+    T = task_req.shape[0]
+    # Candidate depth for the pool style: a task voids at most one
+    # column per commit, and the LAST commit's selection sees at most
+    # COMMITS_PER_ROUND - 1 voids, so top-COMMITS_PER_ROUND per shard
+    # is exactly enough for the pool max to equal the full-matrix
+    # post-void argmax at every commit.
+    C = COMMITS_PER_ROUND
+    arange_t = jnp.arange(T, dtype=jnp.int32)
+    shard = lax.axis_index(NODE_AXIS)
+    nshards = lax.psum(1, NODE_AXIS)
+
+    pending = assigned < 0
+    q_over = less_equal(queue_deserved, qalloc, eps)
+    task_ok = (
+        pending & task_sel & ~q_over[task_queue] & ~blocked_of(failed)
+    )
+
+    # Local node slices of the replicated tables.
+    idle_l = lax.dynamic_slice_in_dim(idle, n_off, n_local)
+    cap_l = lax.dynamic_slice_in_dim(node_cap, n_off, n_local)
+    ntask_l = lax.dynamic_slice_in_dim(ntask, n_off, n_local)
+    maxt_l = lax.dynamic_slice_in_dim(node_max_tasks, n_off, n_local)
+    cap_ok_l = (maxt_l == 0) | (ntask_l < maxt_l)
+
+    # Column-level masks only; row gates apply at the pool. The keys are
+    # stale within the round by design (same as the single-device
+    # multi-commit): fits/budgets are re-checked exactly in every
+    # _commit_bids against the updated idle/qalloc.
+    fits_l = less_equal(task_fit[:, None, :], idle_l[None, :, :], eps)
+    mask_l = fits_l & feas_l & cap_ok_l[None, :] & task_sel[:, None]
+
+    score_l = _dyn_score_core(
+        task_req[:, None, (CPU_DIM, MEM_DIM)],
+        idle_l[None, :, (CPU_DIM, MEM_DIM)],
+        cap_l[None, :, (CPU_DIM, MEM_DIM)],
+        lr_weight, br_weight,
+    ) + static_l
+    # GLOBAL column ids in the hash so keys match the single-device
+    # kernel bit-for-bit.
+    key_l = bid_keys(
+        score_l,
+        task_ids[:, None],
+        (n_off + jnp.arange(n_local, dtype=jnp.int32))[None, :],
+    )
+    key_l = jnp.where(mask_l, key_l, -1)
+
+    Q = qalloc.shape[0]
+    Rr = idle.shape[1]
+
+    def broadcast_from_shard0(do_commits):
+        """Run ``do_commits`` on shard 0 only and psum-broadcast its
+        packed (i32, f32) result buffers (zeros elsewhere)."""
+
+        def skip_commits(_):
+            return (
+                jnp.zeros((T + N + 1,), jnp.int32),
+                jnp.zeros((N * Rr + Q * Rr,), jnp.float32),
+            )
+
+        ibuf, fbuf = lax.psum(
+            lax.cond(shard == 0, do_commits, skip_commits, None),
+            NODE_AXIS,
+        )
+        return (
+            ibuf[:T],                       # assigned
+            fbuf[: N * Rr].reshape(N, Rr),  # idle
+            ibuf[T:T + N],                  # ntask
+            fbuf[N * Rr:].reshape(Q, Rr),   # qalloc
+            ibuf[T + N] > 0,                # any_accept
+        )
+
+    def pack_commit_result(assigned_, idle_, ntask_, qalloc_, acc_):
+        return (
+            jnp.concatenate(
+                [assigned_, ntask_, acc_.astype(jnp.int32)[None]]
+            ),
+            jnp.concatenate([idle_.ravel(), qalloc_.ravel()]),
+        )
+
+    if style == "pool":
+        # Per-shard top-C candidates by iterative argmax+void (lax.top_k
+        # lowers poorly at these shapes on both TPU and CPU; argmax
+        # chains match the single-device kernel's tie-break exactly:
+        # first index of the max). Python-unrolled — C is small and
+        # static, and accumulating via .at[i].set inside a fori_loop
+        # costs a [C, T] scatter per step (measured ~80 ms/round at
+        # 10k) where unrolled collection is a free stack.
+        ck_list, cn_list = [], []
+        for _ in range(C):
+            b = jnp.argmax(key_l, axis=1).astype(jnp.int32)
+            ck_list.append(key_l[arange_t, b])
+            cn_list.append(n_off + b)
+            key_l = key_l.at[arange_t, b].set(-1)
+        ck = jnp.stack(ck_list)
+        cn = jnp.stack(cn_list)
+
+        # ONE gather -> replicated candidate pool [s*C, T].
+        g = lax.all_gather(jnp.stack([ck, cn]), NODE_AXIS)  # [s, 2, C, T]
+        pool_k = g[:, 0].reshape(nshards * C, T)
+        pool_n = g[:, 1].reshape(nshards * C, T)
+
+        # Job-break verdict: any feasible column anywhere == pool top-1
+        # somewhere. (For gated rows any_feas may differ from the
+        # single-device value, but ``failed`` is ANDed with task_ok
+        # exactly like _solve_round, so the verdict matches.)
+        any_feas = jnp.max(pool_k, axis=0) >= 0
+        failed = failed | (task_ok & ~any_feas & ~fits_releasing)
+        gate = task_ok & ~blocked_of(failed)
+
+        def do_commits(_):
+            def commit_once(_, state):
+                assigned, idle, ntask, qalloc, any_acc, pool_k = state
+                live = gate & (assigned < 0)
+                wkey = jnp.max(pool_k, axis=0)
+                # Lowest global node among max-key entries == the full
+                # matrix argmax's first-max-index rule.
+                wnode = jnp.min(
+                    jnp.where(pool_k == wkey[None, :], pool_n, INT_MAX),
+                    axis=0,
+                )
+                has_bid = live & (wkey >= 0)
+                bid = jnp.where(has_bid, wnode, N)
+                assigned, idle, ntask, qalloc, acc = _commit_bids(
+                    bid, assigned, idle, ntask, qalloc,
+                    task_req=task_req, task_fit=task_fit,
+                    task_rank=task_rank, task_queue=task_queue,
+                    node_max_tasks=node_max_tasks,
+                    queue_deserved=queue_deserved, eps=eps,
+                )
+                # Losers stop re-bidding the column they just lost:
+                # void that (task, node) pool entry (global node ids
+                # are unique across shards, so exactly one matches).
+                lost = has_bid & (assigned < 0)
+                pool_k = jnp.where(
+                    lost[None, :] & (pool_n == wnode[None, :]), -1,
+                    pool_k,
+                )
+                return (
+                    assigned, idle, ntask, qalloc, any_acc | acc, pool_k
+                )
+
+            assigned_, idle_, ntask_, qalloc_, acc_, _ = lax.fori_loop(
+                0, COMMITS_PER_ROUND, commit_once,
+                (
+                    assigned, idle, ntask, qalloc, jnp.asarray(False),
+                    pool_k,
+                ),
+            )
+            return pack_commit_result(
+                assigned_, idle_, ntask_, qalloc_, acc_
+            )
+
+        assigned, idle, ntask, qalloc, any_accept = broadcast_from_shard0(
+            do_commits
+        )
+        return assigned, idle, ntask, qalloc, failed, any_accept
+
+    # ---- style == "commit": per-commit reconcile ----------------------
+    # Each commit re-argmaxes the live local [T, N/s] key block and
+    # reconciles with one packed two-vector gather; the commit itself
+    # runs on shard 0 and broadcasts. 2 collectives per commit. The
+    # job-break verdict folds into the FIRST commit's gather (the
+    # gathered maxima give any-feasible), so no separate psum.
+    def commit_once(c, state):
+        assigned, idle, ntask, qalloc, any_acc, key_l, failed, gate = state
+        live = assigned < 0
+        lbid = jnp.argmax(key_l, axis=1).astype(jnp.int32)
+        lkey = key_l[arange_t, lbid]
+        gkn = lax.all_gather(
+            jnp.stack([lkey, lbid]), NODE_AXIS
+        )                                              # [s, 2, T]
+        gk, gn = gkn[:, 0, :], gkn[:, 1, :]
+        wshard = jnp.argmax(gk, axis=0).astype(jnp.int32)
+        wkey = jnp.max(gk, axis=0)
+        wnode = jnp.take_along_axis(gn, wshard[None, :], axis=0)[0]
+        # First commit: derive the job-break verdict from the gathered
+        # maxima (any feasible column anywhere <=> max key >= 0 — the
+        # keys are void-free at this point). ``failed``/``gate`` are
+        # loop-invariant afterwards, so carry them instead of paying
+        # the O(T) job-block scan on every commit on every shard.
+        failed = jnp.where(
+            c == 0,
+            failed | (task_ok & ~(wkey >= 0) & ~fits_releasing),
+            failed,
+        )
+        gate = lax.cond(
+            c == 0,
+            lambda _: task_ok & ~blocked_of(failed),
+            lambda _: gate,
+            None,
+        )
+        has_bid = gate & live & (wkey >= 0)
+        bid = jnp.where(has_bid, wshard * n_local + wnode, N)
+
+        def do_commit(_):
+            return pack_commit_result(*_commit_bids(
+                bid, assigned, idle, ntask, qalloc,
+                task_req=task_req, task_fit=task_fit,
+                task_rank=task_rank, task_queue=task_queue,
+                node_max_tasks=node_max_tasks,
+                queue_deserved=queue_deserved, eps=eps,
+            ))
+
+        def skip_commit(_):
+            return (
+                jnp.zeros((T + N + 1,), jnp.int32),
+                jnp.zeros((N * Rr + Q * Rr,), jnp.float32),
+            )
+
+        ibuf, fbuf = lax.psum(
+            lax.cond(shard == 0, do_commit, skip_commit, None),
+            NODE_AXIS,
+        )
+        assigned = ibuf[:T]
+        ntask = ibuf[T:T + N]
+        acc = ibuf[T + N] > 0
+        idle = fbuf[: N * Rr].reshape(N, Rr)
+        qalloc = fbuf[N * Rr:].reshape(Q, Rr)
+        # Void lost columns — only the owner shard holds that column.
+        lost = has_bid & (assigned < 0)
+        mine = wshard == shard
+        col = jnp.where(has_bid & mine, wnode, 0)
+        key_l = key_l.at[arange_t, col].set(
+            jnp.where(lost & mine, -1, key_l[arange_t, col])
+        )
+        return (
+            assigned, idle, ntask, qalloc, any_acc | acc, key_l, failed,
+            gate,
+        )
+
+    (
+        assigned, idle, ntask, qalloc, any_accept, _, failed, _
+    ) = lax.fori_loop(
+        0, COMMITS_PER_ROUND, commit_once,
+        (
+            assigned, idle, ntask, qalloc, jnp.asarray(False), key_l,
+            failed, jnp.zeros((T,), bool),
+        ),
+    )
+    return assigned, idle, ntask, qalloc, failed, any_accept
+
+
+def _solve_spmd_local(inputs: SolverInputs, max_rounds: int,
+                      tail_bucket: int, staged: bool):
+    """The per-shard body (runs under shard_map). ``inputs`` fields are
+    LOCAL blocks for the four column-factorized fields and full
+    replicated arrays for everything else."""
+    T, R = inputs.task_req.shape
+    n_local = inputs.node_feas.shape[0]          # local column count
+    N = inputs.node_idle.shape[0]                # full (replicated) table
+    shard = lax.axis_index(NODE_AXIS)
+    n_off = shard * n_local
+    eps = inputs.eps
+
+    feas_l = _local_feasibility(inputs, n_local, inputs.task_valid)
+    static_l = _local_static_score(inputs, n_local)
+
+    rel_l = lax.dynamic_slice_in_dim(inputs.node_releasing, n_off, n_local)
+    fits_releasing = lax.psum(
+        jnp.any(
+            less_equal(inputs.task_fit[:, None, :], rel_l[None, :, :], eps)
+            & feas_l,
+            axis=1,
+        ).astype(jnp.int32),
+        NODE_AXIS,
+    ) > 0
+
+    def job_blocked(failed):
+        first_fail = jax.ops.segment_min(
+            jnp.where(failed, inputs.task_rank, INT_MAX),
+            inputs.task_job,
+            num_segments=T,
+        )
+        return inputs.task_rank > first_fail[inputs.task_job]
+
+    shared_kw = dict(
+        node_cap=inputs.node_cap, node_max_tasks=inputs.node_max_tasks,
+        queue_deserved=inputs.queue_deserved,
+        lr_weight=inputs.lr_weight, br_weight=inputs.br_weight, eps=eps,
+        n_off=n_off,
+    )
+    head_kw = dict(
+        task_req=inputs.task_req, task_fit=inputs.task_fit,
+        task_rank=inputs.task_rank, task_queue=inputs.task_queue,
+        task_sel=inputs.task_valid,
+        task_ids=jnp.arange(T, dtype=jnp.int32),
+        feas_l=feas_l, static_l=static_l,
+        fits_releasing=fits_releasing, blocked_of=job_blocked,
+        n_local=n_local,
+        style="pool" if T <= _POOL_MAX_T else "commit",
+        **shared_kw,
+    )
+
+    init = (
+        jnp.full((T,), -1, jnp.int32),
+        inputs.node_idle,
+        inputs.node_task_count,
+        inputs.queue_allocated,
+        jnp.zeros((T,), bool),
+        jnp.array(True),
+        jnp.array(0, jnp.int32),
+    )
+
+    if not staged:
+        def body(state):
+            assigned, idle, ntask, qalloc, failed, _, rnd = state
+            out = _spmd_round(
+                assigned, idle, ntask, qalloc, failed, **head_kw
+            )
+            return (*out[:5], out[5], rnd + 1)
+
+        def cond(state):
+            return state[5] & (state[6] < max_rounds)
+
+        assigned, idle, _, qalloc, _, _, rounds = lax.while_loop(
+            cond, body, init
+        )
+        return SolverResult(assigned, idle, qalloc, rounds)
+
+    # ---- staged: full-width head + compacted tail (solve_staged's
+    # structure with local column blocks) ------------------------------
+    B = tail_bucket
+
+    def head_body(state):
+        assigned, idle, ntask, qalloc, failed, _, rnd, _ = state
+        assigned, idle, ntask, qalloc, failed, any_accept = _spmd_round(
+            assigned, idle, ntask, qalloc, failed, **head_kw
+        )
+        q_over = less_equal(inputs.queue_deserved, qalloc, eps)
+        still = jnp.sum(
+            (
+                (assigned < 0)
+                & inputs.task_valid
+                & ~failed
+                & ~q_over[inputs.task_queue]
+                & ~job_blocked(failed)
+            ).astype(jnp.int32)
+        )
+        return (
+            assigned, idle, ntask, qalloc, failed, any_accept, rnd + 1,
+            still,
+        )
+
+    def head_cond(state):
+        return state[5] & (state[6] < max_rounds) & (state[7] > B)
+
+    (
+        assigned, idle, ntask, qalloc, failed, _, rounds, _
+    ) = lax.while_loop(head_cond, head_body, (*init, jnp.array(T, jnp.int32)))
+
+    def subset_feas(idxs, valid2):
+        f2 = (
+            inputs.group_feas[inputs.task_group[idxs]]
+            & inputs.node_feas[None, :]
+            & valid2[:, None]
+        )
+        Pn = inputs.pair_idx.shape[0]
+        if Pn:
+            pos = jnp.clip(
+                jnp.searchsorted(inputs.pair_idx, idxs), 0, Pn - 1
+            )
+            match = inputs.pair_idx[pos] == idxs
+            f2 = f2 & jnp.where(
+                match[:, None], inputs.pair_feas[pos], True
+            )
+        return f2
+
+    def subset_static(idxs):
+        S = inputs.score_idx.shape[0]
+        if not S:
+            return jnp.zeros((), jnp.float32)
+        pos = jnp.clip(jnp.searchsorted(inputs.score_idx, idxs), 0, S - 1)
+        match = inputs.score_idx[pos] == idxs
+        return jnp.where(match[:, None], inputs.score_rows[pos], 0.0)
+
+    def tail_outer_body(ostate):
+        assigned, idle, ntask, qalloc, failed, _, rounds, stages = ostate
+
+        blocked = job_blocked(failed)
+        q_over = less_equal(inputs.queue_deserved, qalloc, eps)
+        elig = (
+            (assigned < 0)
+            & inputs.task_valid
+            & ~failed
+            & ~blocked
+            & ~q_over[inputs.task_queue]
+        )
+        sel_key = jnp.where(elig, inputs.task_rank, INT_MAX)
+        _, idxs = lax.top_k(-sel_key, B)
+        idxs = idxs.astype(jnp.int32)
+        valid2 = sel_key[idxs] != INT_MAX
+
+        arange_b = jnp.arange(B, dtype=jnp.int32)
+        job2 = inputs.task_job[idxs]
+        rank2 = inputs.task_rank[idxs]
+        sjob, srank2, jord = lax.sort((job2, rank2, arange_b), num_keys=2)
+        jstart = jnp.concatenate(
+            [jnp.ones((1,), bool), sjob[1:] != sjob[:-1]]
+        )
+        inv_jord = jnp.zeros((B,), jnp.int32).at[jord].set(arange_b)
+
+        def blocked_from(failed2):
+            f_rank = jnp.where(failed2[jord], srank2, INT_MAX)
+            prefmin = segmented_cummin(f_rank, jstart)
+            return (srank2 > prefmin)[inv_jord]
+
+        tail_kw = dict(
+            task_req=inputs.task_req[idxs], task_fit=inputs.task_fit[idxs],
+            task_rank=rank2, task_queue=inputs.task_queue[idxs],
+            task_sel=valid2, task_ids=idxs,
+            feas_l=subset_feas(idxs, valid2),
+            static_l=subset_static(idxs),
+            fits_releasing=fits_releasing[idxs],
+            blocked_of=blocked_from,
+            n_local=n_local,
+            style="pool" if B <= _POOL_MAX_T else "commit",
+            **shared_kw,
+        )
+
+        def tail_body(state):
+            sub_assigned, idle, ntask, qalloc, failed2, _, rnd = state
+            out = _spmd_round(
+                sub_assigned, idle, ntask, qalloc, failed2, **tail_kw
+            )
+            return (*out[:5], out[5], rnd + 1)
+
+        def tail_cond(state):
+            return state[5] & (state[6] < max_rounds)
+
+        tstate = (
+            jnp.full((B,), -1, jnp.int32), idle, ntask, qalloc,
+            failed[idxs], jnp.array(True), rounds,
+        )
+        (
+            sub_assigned, idle, ntask, qalloc, failed2, _, rounds
+        ) = lax.while_loop(tail_cond, tail_body, tstate)
+
+        placed2 = sub_assigned >= 0
+        assigned = assigned.at[idxs].set(
+            jnp.where(placed2, sub_assigned, assigned[idxs])
+        )
+        failed = failed.at[idxs].set(failed2)
+        return (
+            assigned, idle, ntask, qalloc, failed,
+            jnp.any(placed2), rounds, stages + 1,
+        )
+
+    def tail_outer_cond(ostate):
+        progressed, rounds, stages = ostate[5], ostate[6], ostate[7]
+        assigned, qalloc, failed = ostate[0], ostate[3], ostate[4]
+        q_over = less_equal(inputs.queue_deserved, qalloc, eps)
+        remaining = jnp.any(
+            (assigned < 0) & inputs.task_valid & ~failed
+            & ~job_blocked(failed) & ~q_over[inputs.task_queue]
+        )
+        return (
+            progressed & remaining & (rounds < max_rounds)
+            & (stages < 64)
+        )
+
+    ostate = (
+        assigned, idle, ntask, qalloc, failed,
+        jnp.array(True), rounds, jnp.array(0, jnp.int32),
+    )
+    (
+        assigned, idle, _, qalloc, _, _, rounds, stages
+    ) = lax.while_loop(tail_outer_cond, tail_outer_body, ostate)
+    return SolverResult(assigned, idle, qalloc, rounds, stages)
+
+
+@functools.lru_cache(maxsize=32)
+def _spmd_step(mesh: Mesh, staged, max_rounds, tail_bucket):
+    """Jitted shard_map solve for a mesh (cached per config)."""
+
+    def run(inputs):
+        if isinstance(inputs, PackedInputs):
+            inputs = inputs.unpack()  # inside jit: free slicing
+        in_specs = SolverInputs(**{
+            f: _SHARDED_SPECS.get(f, P()) for f in SolverInputs._fields
+        })
+        fn = shard_map(
+            functools.partial(
+                _solve_spmd_local,
+                max_rounds=max_rounds,
+                tail_bucket=tail_bucket,
+                staged=staged,
+            ),
+            mesh=mesh,
+            in_specs=(in_specs,),
+            out_specs=P(),
+            # Replication of the outputs is by construction (the commit
+            # runs on replicated operands on every shard); the static
+            # checker cannot see through the while_loop carries.
+            check_rep=False,
+        )
+        return fn(inputs)
+
+    return jax.jit(run)
+
+
+def solve_spmd(
+    inputs,
+    mesh: Mesh,
+    max_rounds: int = 256,
+    staged: bool = False,
+    tail_bucket: int = 3072,
+) -> SolverResult:
+    """Run the hierarchical sharded solve on ``mesh``. Same results as
+    the single-device ``solve`` (or ``solve_staged`` when ``staged``),
+    bit-exact. Node axis must be padded to a multiple of ``mesh.size``
+    (sharding.pad_nodes; the production tensorize buckets N to 128s)."""
+    return _spmd_step(mesh, staged, max_rounds, tail_bucket)(inputs)
