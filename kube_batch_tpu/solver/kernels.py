@@ -689,6 +689,66 @@ def solve(inputs: SolverInputs, max_rounds: int = 256,
     return SolverResult(assigned, idle, qalloc, rounds)
 
 
+_INT_MAX = jnp.iinfo(jnp.int32).max
+
+
+def tail_subset_feas(inputs: SolverInputs, idxs, valid2):
+    """Rebuild the factorized predicate-mask rows for a compacted task
+    subset. Reads only ``inputs`` fields, so it works identically on
+    full node tables and on a shard's local column blocks (the sharded
+    tail in solver/spmd.py shares this exact code path — the staged
+    solvers' bit-exact-parity contract depends on it)."""
+    f2 = (
+        inputs.group_feas[inputs.task_group[idxs]]
+        & inputs.node_feas[None, :]
+        & valid2[:, None]
+    )
+    P = inputs.pair_idx.shape[0]
+    if P:
+        pos = jnp.clip(jnp.searchsorted(inputs.pair_idx, idxs), 0, P - 1)
+        match = inputs.pair_idx[pos] == idxs
+        f2 = f2 & jnp.where(match[:, None], inputs.pair_feas[pos], True)
+    return f2
+
+
+def tail_subset_static(inputs: SolverInputs, idxs):
+    """Static score rows for a compacted subset (see tail_subset_feas
+    for the shared-with-spmd contract)."""
+    S = inputs.score_idx.shape[0]
+    if not S:
+        return jnp.zeros((), jnp.float32)
+    pos = jnp.clip(jnp.searchsorted(inputs.score_idx, idxs), 0, S - 1)
+    match = inputs.score_idx[pos] == idxs
+    return jnp.where(match[:, None], inputs.score_rows[pos], 0.0)
+
+
+def tail_local_blocked(inputs: SolverInputs, idxs, B):
+    """Subset-local job-break scan for a compacted tail stage.
+
+    Job-break state stays SUBSET-LOCAL during a stage: every eligible
+    lower-rank member of a subset task's job is in the subset too
+    (compaction is by rank), and tasks outside the subset cannot fail
+    mid-stage. Pre-sorts the subset by (job, rank) once; the returned
+    ``blocked_from(failed2)`` recomputes blockage with an O(B) segmented
+    min-scan instead of an O(T) segment_min. Also returns the subset's
+    global ranks (needed by the round body)."""
+    arange_b = jnp.arange(B, dtype=jnp.int32)
+    job2 = inputs.task_job[idxs]
+    rank2 = inputs.task_rank[idxs]
+    sjob, srank2, jord = lax.sort((job2, rank2, arange_b), num_keys=2)
+    jstart = jnp.concatenate(
+        [jnp.ones((1,), bool), sjob[1:] != sjob[:-1]]
+    )
+    inv_jord = jnp.zeros((B,), jnp.int32).at[jord].set(arange_b)
+
+    def blocked_from(failed2):
+        f_rank = jnp.where(failed2[jord], srank2, _INT_MAX)
+        prefmin = segmented_cummin(f_rank, jstart)
+        return (srank2 > prefmin)[inv_jord]
+
+    return blocked_from, rank2
+
+
 def solve_staged(
     inputs: SolverInputs,
     max_rounds: int = 256,
@@ -730,7 +790,6 @@ def solve_staged(
 
     feas0 = build_feasibility(inputs)
     static_score = build_static_score(inputs)
-    static_is_matrix = static_score.ndim == 2
 
     fits_releasing = jnp.any(
         less_equal(
@@ -817,34 +876,6 @@ def solve_staged(
     # ---------------- tail: compacted rounds ---------------------------
     B = tail_bucket
 
-    def subset_feas(idxs, valid2):
-        """Rebuild the factorized mask rows for the compacted subset."""
-        f2 = (
-            inputs.group_feas[inputs.task_group[idxs]]
-            & inputs.node_feas[None, :]
-            & valid2[:, None]
-        )
-        P = inputs.pair_idx.shape[0]
-        if P:
-            pos = jnp.clip(
-                jnp.searchsorted(inputs.pair_idx, idxs), 0, P - 1
-            )
-            match = inputs.pair_idx[pos] == idxs
-            f2 = f2 & jnp.where(
-                match[:, None], inputs.pair_feas[pos], True
-            )
-        return f2
-
-    def subset_static(idxs):
-        S = inputs.score_idx.shape[0]
-        if not S or not static_is_matrix:
-            return jnp.zeros((), jnp.float32)
-        pos = jnp.clip(jnp.searchsorted(inputs.score_idx, idxs), 0, S - 1)
-        match = inputs.score_idx[pos] == idxs
-        return jnp.where(
-            match[:, None], inputs.score_rows[pos], 0.0
-        )
-
     def tail_outer_body(ostate):
         assigned, idle, ntask, qalloc, failed, _, rounds, stages = ostate
 
@@ -868,30 +899,11 @@ def solve_staged(
 
         req2 = inputs.task_req[idxs]
         fit2 = inputs.task_fit[idxs]
-        rank2 = inputs.task_rank[idxs]
         queue2 = inputs.task_queue[idxs]
-        feas2 = subset_feas(idxs, valid2)
-        static2 = subset_static(idxs)
+        feas2 = tail_subset_feas(inputs, idxs, valid2)
+        static2 = tail_subset_static(inputs, idxs)
         fits_rel2 = fits_releasing[idxs]
-
-        # Job-break state stays SUBSET-LOCAL during a stage: every
-        # eligible lower-rank member of a subset task's job is in the
-        # subset too (compaction is by rank), and tasks outside the
-        # subset cannot fail mid-stage. Pre-sort the subset by (job,
-        # rank) once; each round recomputes blockage with an O(B)
-        # segmented min-scan instead of an O(T) segment_min.
-        arange_b = jnp.arange(B, dtype=jnp.int32)
-        job2 = inputs.task_job[idxs]
-        sjob, srank2, jord = lax.sort((job2, rank2, arange_b), num_keys=2)
-        jstart = jnp.concatenate(
-            [jnp.ones((1,), bool), sjob[1:] != sjob[:-1]]
-        )
-        inv_jord = jnp.zeros((B,), jnp.int32).at[jord].set(arange_b)
-
-        def blocked_from(failed2):
-            f_rank = jnp.where(failed2[jord], srank2, INT_MAX)
-            prefmin = segmented_cummin(f_rank, jstart)
-            return (srank2 > prefmin)[inv_jord]
+        blocked_from, rank2 = tail_local_blocked(inputs, idxs, B)
 
         tail_kw = dict(
             task_req=req2, task_fit=fit2,

@@ -12,8 +12,9 @@ restructuring conflict resolution hierarchically (VERDICT r4 item 2):
 
 - LOCAL bid: each shard owns N/s node columns. The O(T*N) work — fit
   mask, dynamic scores, integer bid keys, per-task argmax — runs on the
-  local [T, N/s] block only. Each shard reduces to TWO [T] vectors: its
-  best key and best local node per task.
+  local [T, N/s] block only. Each shard reduces to [T]-sized vectors:
+  its best key and best local node per task (per commit), or its
+  top-COMMITS_PER_ROUND candidate lists (pool style, once per round).
 - GLOBAL reconcile: one `all_gather` ships those [T] vectors (s * T * 8
   bytes total — NOT [T, N]); every shard then computes the same global
   winner per task. Ties break toward the lowest shard then lowest local
@@ -73,7 +74,9 @@ from .kernels import (
     COMMITS_PER_ROUND,
     bid_keys,
     less_equal,
-    segmented_cummin,
+    tail_local_blocked,
+    tail_subset_feas,
+    tail_subset_static,
 )
 
 NODE_AXIS = "nodes"
@@ -173,14 +176,15 @@ def _spmd_round(
 
     ``style`` picks the reconcile cadence:
 
-    - ``"pool"``: extract each shard's top-(commits+1) candidates once
-      per round by iterative argmax+void, gather them in ONE collective,
-      and run every commit against the [s*(commits+1), T] pool — 2
-      collectives per round. Within a round voids only remove commit
-      winners, which by construction sit at the top of their shard's
-      list, so after <= COMMITS_PER_ROUND voids the true global argmax
-      always remains inside the pool: exact equivalence with the
-      full-matrix re-argmax.
+    - ``"pool"``: extract each shard's top-COMMITS_PER_ROUND candidates
+      once per round by iterative argmax+void, gather them in ONE
+      collective, and run every commit against the
+      [s*COMMITS_PER_ROUND, T] pool — 2 collectives per round. Within a
+      round voids only remove commit winners, which by construction sit
+      at the top of their shard's list, and the LAST commit's selection
+      sees at most COMMITS_PER_ROUND - 1 voids, so the true global
+      argmax always remains inside the pool at every commit: exact
+      equivalence with the full-matrix re-argmax.
     - ``"commit"``: re-argmax the local block per commit and reconcile
       with one packed two-[T]-vector gather per commit (2 collectives
       per commit, but no extraction pass). The job-break verdict folds
@@ -439,6 +443,12 @@ def _solve_spmd_local(inputs: SolverInputs, max_rounds: int,
     LOCAL blocks for the four column-factorized fields and full
     replicated arrays for everything else."""
     T, R = inputs.task_req.shape
+    if staged and T <= tail_bucket:
+        # solve_staged's escape: a snapshot smaller than the tail bucket
+        # IS one tail-sized block — the full-width solve is the same
+        # program without the compaction scaffolding (lax.top_k would
+        # reject k > T).
+        staged = False
     n_local = inputs.node_feas.shape[0]          # local column count
     N = inputs.node_idle.shape[0]                # full (replicated) table
     shard = lax.axis_index(NODE_AXIS)
@@ -541,31 +551,6 @@ def _solve_spmd_local(inputs: SolverInputs, max_rounds: int,
         assigned, idle, ntask, qalloc, failed, _, rounds, _
     ) = lax.while_loop(head_cond, head_body, (*init, jnp.array(T, jnp.int32)))
 
-    def subset_feas(idxs, valid2):
-        f2 = (
-            inputs.group_feas[inputs.task_group[idxs]]
-            & inputs.node_feas[None, :]
-            & valid2[:, None]
-        )
-        Pn = inputs.pair_idx.shape[0]
-        if Pn:
-            pos = jnp.clip(
-                jnp.searchsorted(inputs.pair_idx, idxs), 0, Pn - 1
-            )
-            match = inputs.pair_idx[pos] == idxs
-            f2 = f2 & jnp.where(
-                match[:, None], inputs.pair_feas[pos], True
-            )
-        return f2
-
-    def subset_static(idxs):
-        S = inputs.score_idx.shape[0]
-        if not S:
-            return jnp.zeros((), jnp.float32)
-        pos = jnp.clip(jnp.searchsorted(inputs.score_idx, idxs), 0, S - 1)
-        match = inputs.score_idx[pos] == idxs
-        return jnp.where(match[:, None], inputs.score_rows[pos], 0.0)
-
     def tail_outer_body(ostate):
         assigned, idle, ntask, qalloc, failed, _, rounds, stages = ostate
 
@@ -583,26 +568,16 @@ def _solve_spmd_local(inputs: SolverInputs, max_rounds: int,
         idxs = idxs.astype(jnp.int32)
         valid2 = sel_key[idxs] != INT_MAX
 
-        arange_b = jnp.arange(B, dtype=jnp.int32)
-        job2 = inputs.task_job[idxs]
-        rank2 = inputs.task_rank[idxs]
-        sjob, srank2, jord = lax.sort((job2, rank2, arange_b), num_keys=2)
-        jstart = jnp.concatenate(
-            [jnp.ones((1,), bool), sjob[1:] != sjob[:-1]]
-        )
-        inv_jord = jnp.zeros((B,), jnp.int32).at[jord].set(arange_b)
-
-        def blocked_from(failed2):
-            f_rank = jnp.where(failed2[jord], srank2, INT_MAX)
-            prefmin = segmented_cummin(f_rank, jstart)
-            return (srank2 > prefmin)[inv_jord]
-
+        # Shared with kernels.solve_staged: inside shard_map the four
+        # column-factorized inputs fields are the LOCAL blocks, so the
+        # same subset builders produce [B, N/s] rows here.
+        blocked_from, rank2 = tail_local_blocked(inputs, idxs, B)
         tail_kw = dict(
             task_req=inputs.task_req[idxs], task_fit=inputs.task_fit[idxs],
             task_rank=rank2, task_queue=inputs.task_queue[idxs],
             task_sel=valid2, task_ids=idxs,
-            feas_l=subset_feas(idxs, valid2),
-            static_l=subset_static(idxs),
+            feas_l=tail_subset_feas(inputs, idxs, valid2),
+            static_l=tail_subset_static(inputs, idxs),
             fits_releasing=fits_releasing[idxs],
             blocked_of=blocked_from,
             n_local=n_local,

@@ -75,6 +75,11 @@ class FakeKube:
         self.rv = 0
         self.last_auth = None      # Authorization header of last request
         self.reject_token = None   # bearer token to 401 (auth tests)
+        # Failure injection (error-path fixtures): callable
+        # (method, path) -> None | (code, status_doc). Return a k8s
+        # Status document shaped like a real apiserver error to have the
+        # request answered with it instead of being served.
+        self.request_hook = None
 
         fake = self
 
@@ -104,6 +109,13 @@ class FakeKube:
                 ):
                     self._json(401, {"kind": "Status", "code": 401})
                     return False
+                hook = fake.request_hook
+                if hook is not None:
+                    injected = hook(self.command, self.path)
+                    if injected is not None:
+                        code, body = injected
+                        self._json(code, body)
+                        return False
                 return True
 
             def do_GET(self):
@@ -170,6 +182,8 @@ class FakeKube:
                 })
 
             def do_POST(self):
+                if not self._auth_gate():
+                    return
                 if self.path.endswith("/leases"):
                     body = self._read_body()
                     name = body["metadata"]["name"]
@@ -205,12 +219,16 @@ class FakeKube:
                 self._json(404, {"code": 404})
 
             def do_PATCH(self):
+                if not self._auth_gate():
+                    return
                 body = self._read_body()
                 with fake.lock:
                     fake.status_patches.append((self.path, body))
                 self._json(200, {"kind": "Status", "status": "Success"})
 
             def do_PUT(self):
+                if not self._auth_gate():
+                    return
                 if "/leases/" not in self.path:
                     self._json(404, {"code": 404})
                     return
@@ -233,6 +251,8 @@ class FakeKube:
                 self._json(200, body)
 
             def do_DELETE(self):
+                if not self._auth_gate():
+                    return
                 parts = self.path.split("/")
                 ns, name = parts[4], parts[6]
                 with fake.lock:
@@ -267,6 +287,37 @@ class FakeKube:
         with self.lock:
             self.objects[kind][self._key(doc)] = doc
             self._emit(kind, "ADDED", doc)
+
+    def remove_silently(self, kind, key):
+        """Delete an object WITHOUT emitting a watch event — simulates a
+        deletion the client's watch missed (e.g. during a 410 gap)."""
+        with self.lock:
+            self.objects[kind].pop(key, None)
+
+    def emit_error(self, kind, code, reason="Expired"):
+        """Send a watch ERROR event shaped like a real apiserver's (a
+        Status document as the object), e.g. 410 Gone after resource-
+        version expiry."""
+        with self.lock:
+            for q in self.subscribers[kind]:
+                q.put({
+                    "type": "ERROR",
+                    "object": {
+                        "kind": "Status", "apiVersion": "v1",
+                        "status": "Failure", "reason": reason,
+                        "code": code,
+                        "message": f"too old resource version ({reason})",
+                    },
+                })
+
+    def kick_watchers(self, kind):
+        """Close every open watch stream for ``kind`` (server-side
+        disconnect); clients are expected to reconnect from their last
+        resourceVersion."""
+        with self.lock:
+            for q in self.subscribers[kind]:
+                q.put(None)
+            self.subscribers[kind] = []
 
     def close(self):
         with self.lock:

@@ -180,6 +180,15 @@ class TestShardedParity:
         sharded = solve_sharded(inputs, mesh, max_rounds=64, staged=False)
         assert_same_result(single, sharded, N)
 
+    def test_staged_true_smaller_than_tail_bucket(self, mesh):
+        # Forcing staged=True on a snapshot smaller than the tail bucket
+        # must fall back to the full-width solve (solve_staged's escape)
+        # instead of tracing lax.top_k with k > T.
+        inputs = synthetic_inputs(48, 16, seed=5)
+        single = solve(inputs, max_rounds=64)
+        sharded = solve_sharded(inputs, mesh, max_rounds=64, staged=True)
+        assert_same_result(single, sharded, 16)
+
     def test_smaller_mesh_subset(self, mesh):
         # A 2-device sub-mesh (distinct sharding layout) agrees too.
         sub = Mesh(np.asarray(jax.devices()[:2]), ("nodes",))

@@ -295,12 +295,11 @@ class TestRelistDeleteReconciliation:
         else:
             raise AssertionError("pod never occupied the node")
 
-        # The pod vanishes while every watch stream is down (410 Gone):
-        # no DELETED event is ever sent for it.
-        with fake.lock:
-            del fake.objects["Pod"]["default/p1"]
-        for q in list(fake.subscribers["Pod"]):
-            q.put({"type": "ERROR", "object": {"code": 410}})
+        # The pod vanishes while the watch is in a 410 gap: no DELETED
+        # event is ever sent for it, then the stream errors with a
+        # real-apiserver-shaped Gone Status document.
+        fake.remove_silently("Pod", "default/p1")
+        fake.emit_error("Pod", 410)
 
         deadline = time.time() + 10
         while time.time() < deadline:
@@ -335,10 +334,8 @@ class TestRelistDeleteReconciliation:
                 break
             time.sleep(0.02)
 
-        with fake.lock:
-            del fake.objects["Node"]["n2"]
-        for q in list(fake.subscribers["Node"]):
-            q.put({"type": "ERROR", "object": {"code": 410}})
+        fake.remove_silently("Node", "n2")
+        fake.emit_error("Node", 410)
 
         deadline = time.time() + 10
         while time.time() < deadline:
@@ -351,6 +348,207 @@ class TestRelistDeleteReconciliation:
         stop.set()
         cluster.stop()
         cache.shutdown()
+
+
+def _status_doc(code, reason, message):
+    """A Status document shaped like a real apiserver error response."""
+    return {
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "reason": reason, "message": message, "code": code,
+    }
+
+
+class TestApiErrorPaths:
+    """Fixture-driven apiserver error shapes against the real adapter
+    (VERDICT r4 item 6): RBAC 403 on the watch, 409 conflict on a status
+    PATCH, 403 on a bind POST, and server-side watch disconnects — the
+    error paths the in-repo fake never exercised. Reference behavior
+    being matched: client-go reflector/clientset semantics
+    (reference cache.go:270-352)."""
+
+    def test_watch_disconnect_resumes_without_duplicate_events(self, fake):
+        fake.create("Pod", pod_doc("p1"))
+        cluster = make_cluster(fake)
+        got = []
+        cluster.add_watch(
+            lambda kind, etype, obj: got.append(
+                (kind, etype, obj.metadata.name)
+            )
+        )
+        # cache-backed kinds prime via LIST, so the initial watch carries
+        # no replay; wait for the stream to establish, then deliver one
+        # event so the adapter learns a resourceVersion to resume from
+        # (with no rv a reconnect MUST relist — reflector semantics).
+        deadline = time.time() + 5
+        while time.time() < deadline and not fake.subscribers["Pod"]:
+            time.sleep(0.02)
+        assert fake.subscribers["Pod"], "watch never connected"
+        fake.create("Pod", pod_doc("p-rv"))
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            ("Pod", "ADDED", "p-rv") not in got
+        ):
+            time.sleep(0.02)
+        assert ("Pod", "ADDED", "p-rv") in got
+
+        fake.kick_watchers("Pod")  # server-side disconnect
+        deadline = time.time() + 5
+        while time.time() < deadline and not fake.subscribers["Pod"]:
+            time.sleep(0.02)
+        assert fake.subscribers["Pod"], "watch never reconnected"
+
+        fake.create("Pod", pod_doc("p2"))
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            ("Pod", "ADDED", "p2") not in got
+        ):
+            time.sleep(0.02)
+        assert ("Pod", "ADDED", "p2") in got
+        # Reconnect resumed from the learned resourceVersion: no relist,
+        # so neither pre-disconnect pod is replayed as a duplicate ADDED.
+        assert ("Pod", "ADDED", "p1") not in got
+        assert got.count(("Pod", "ADDED", "p-rv")) == 1
+        cluster.stop()
+
+    def test_watch_403_escalates_after_consecutive_failures(
+        self, fake, caplog
+    ):
+        import logging
+
+        forbidden = _status_doc(
+            403, "Forbidden",
+            'pods is forbidden: User "system:serviceaccount:x:y" cannot '
+            'watch resource "pods"',
+        )
+        fake.request_hook = lambda method, path: (
+            (403, forbidden)
+            if method == "GET" and "/pods" in path and "watch=true" in path
+            else None
+        )
+        with caplog.at_level(logging.WARNING, logger="kube_batch_tpu"):
+            cluster = make_cluster(fake)
+            got = []
+            cluster.add_watch(
+                lambda kind, etype, obj: got.append((kind, etype))
+            )
+            deadline = time.time() + 10
+            while time.time() < deadline and not any(
+                "view of Pod objects is stale" in r.message
+                for r in caplog.records
+            ):
+                time.sleep(0.05)
+        assert any(
+            "view of Pod objects is stale" in r.message
+            and "HTTP Error 403" in r.message
+            for r in caplog.records
+        ), "persistent 403 never escalated to a warning"
+
+        # RBAC restored: the watch recovers and events flow again. Wait
+        # for the stream to re-establish before emitting — the fake has
+        # no event replay, so an event sent before the reconnect lands
+        # nowhere (a real apiserver would replay from resourceVersion).
+        fake.request_hook = None
+        deadline = time.time() + 10
+        while time.time() < deadline and not fake.subscribers["Pod"]:
+            time.sleep(0.05)
+        assert fake.subscribers["Pod"], "watch never reconnected after 403"
+        fake.create("Pod", pod_doc("p-after"))
+        deadline = time.time() + 10
+        while time.time() < deadline and ("Pod", "ADDED") not in got:
+            time.sleep(0.05)
+        assert ("Pod", "ADDED") in got, "watch never recovered after 403"
+        cluster.stop()
+
+    def test_status_patch_conflict_raises(self, fake):
+        from urllib.error import HTTPError
+
+        fake.create("PodGroup", {
+            "apiVersion": f"{GROUP}/v1alpha1", "kind": "PodGroup",
+            "metadata": {"name": "g1", "namespace": "default"},
+            "spec": {"minMember": 1},
+        })
+        cluster = make_cluster(fake)
+        pg = cluster.list_objects("PodGroup")[0]
+        pg.status.phase = "Running"
+        fake.request_hook = lambda method, path: (
+            (409, _status_doc(
+                409, "Conflict",
+                'Operation cannot be fulfilled on podgroups "g1": the '
+                "object has been modified",
+            ))
+            if method == "PATCH" and path.endswith("/podgroups/g1/status")
+            else None
+        )
+        with pytest.raises(HTTPError) as exc:
+            cluster.update_pod_group(pg)
+        assert exc.value.code == 409
+        # Conflict lifted (next cycle re-derives status from fresh state
+        # and re-patches): the write goes through.
+        fake.request_hook = None
+        cluster.update_pod_group(pg)
+        assert fake.status_patches[-1][0].endswith("/podgroups/g1/status")
+
+    def test_bind_403_scheduler_recovers_next_cycle(self, fake):
+        """A bind POST denied by RBAC must not wedge the task: the cache
+        side effect resyncs it and a later cycle re-binds once the denial
+        clears (same self-correction contract as reference
+        cache.go:480-522)."""
+        fake.create("Queue", {
+            "apiVersion": f"{GROUP}/v1alpha1", "kind": "Queue",
+            "metadata": {"name": "default"}, "spec": {"weight": 1},
+        })
+        fake.create("PodGroup", {
+            "apiVersion": f"{GROUP}/v1alpha1", "kind": "PodGroup",
+            "metadata": {"name": "g1", "namespace": "default"},
+            "spec": {"minMember": 1, "queue": "default"},
+        })
+        fake.create("Node", node_doc("n1"))
+        fake.create("Pod", pod_doc("p1", group="g1"))
+
+        denied = {"count": 0}
+
+        def deny_bindings(method, path):
+            if method == "POST" and path.endswith("/binding"):
+                if denied["count"] < 2:
+                    denied["count"] += 1
+                    return (403, _status_doc(
+                        403, "Forbidden",
+                        'pods/binding is forbidden: User cannot create '
+                        'resource "pods/binding"',
+                    ))
+            return None
+
+        fake.request_hook = deny_bindings
+        cluster = make_cluster(fake)
+        cache = SchedulerCache(cluster=cluster)
+        sched = Scheduler(cache, schedule_period=0.05)
+        stop = threading.Event()
+        t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+        t.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and denied["count"] < 2:
+                time.sleep(0.05)
+            assert denied["count"] >= 2, "bind POST was never attempted"
+            deadline = time.time() + 20
+            ok = False
+            while time.time() < deadline:
+                with fake.lock:
+                    pods = list(fake.objects["Pod"].values())
+                if fake.bindings and all(
+                    p["status"]["phase"] == "Running" for p in pods
+                ):
+                    ok = True
+                    break
+                time.sleep(0.05)
+            assert ok, (
+                f"pod never bound after RBAC denial cleared: "
+                f"bindings={fake.bindings}"
+            )
+        finally:
+            stop.set()
+            cluster.stop()
+            t.join(timeout=5)
 
 
 class TestCredentialPlugins:
