@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf bench verify ci image clean
+.PHONY: all native test e2e perf perf-quick bench verify ci image clean
 
 all: native
 
@@ -21,12 +21,22 @@ e2e:
 
 # Density perf harness at the reference's kubemark design scale
 # (doc/design/Benchmark/kubemark/kubemark-benchmarking.md:40), plus the
-# BASELINE config (5) multitenant reclaim scenario at 1k nodes.
+# BASELINE config (5) multitenant reclaim scenario at 1k nodes run with
+# BOTH allocate actions (tpu-batch solver vs reference-parity greedy)
+# so the artifact carries the comparison row. ~25 min wall; perf-quick
+# is the CI-sized tier (~2 min).
 perf:
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 3000 --nodes 100 \
 		--group-size 30 --out perf-artifact.json
-	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --scenario multitenant --timeout 900 \
-		--nodes 1000 --group-size 10 --out perf-multitenant.json
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --scenario multitenant-compare \
+		--timeout 900 --nodes 1000 --group-size 10 --out perf-multitenant.json
+
+perf-quick:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 500 --nodes 50 \
+		--group-size 10 --out perf-artifact-quick.json
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --scenario multitenant-compare \
+		--timeout 240 --nodes 100 --group-size 10 \
+		--out perf-multitenant-quick.json
 
 # Headline benchmark (real accelerator when present).
 bench:

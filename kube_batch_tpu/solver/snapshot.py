@@ -190,15 +190,23 @@ def tensorize(
     layout = ResourceLayout.for_session(ssn)
 
     # --- ordered task list: queue rank → job rank → task rank -------------
-    queues = [q for q in ssn.queues.values()]
-    queue_order = _sorted_by(queues, ssn.queue_order_fn)
-    queue_index = {q.uid: i for i, q in enumerate(queue_order)}
-
     jobs_by_queue: Dict[str, List[JobInfo]] = {}
     for job in job_pool:
         if job.queue not in ssn.queues:
             continue
         jobs_by_queue.setdefault(job.queue, []).append(job)
+
+    # Order only queues that HAVE jobs — the greedy loop discovers
+    # queues from jobs (allocate.go:67-99), so plugin queue-order
+    # state (e.g. proportion's queue_attrs, built per job-bearing
+    # queue) may not cover an idle queue; comparing one would KeyError
+    # (seen live: a tenant queue created ahead of its first jobs
+    # crashed every allocate_tpu cycle until the jobs arrived).
+    queues = [
+        q for q in ssn.queues.values() if q.uid in jobs_by_queue
+    ]
+    queue_order = _sorted_by(queues, ssn.queue_order_fn)
+    queue_index = {q.uid: i for i, q in enumerate(queue_order)}
 
     # Per-queue task sequences (jobs by job_order_fn, tasks by
     # task_order_fn). Jobs are few (comparison sort is fine); tasks are

@@ -289,6 +289,25 @@ class TestAllocateTpuParity:
         assert drain(c.binder.channel, 1, timeout=0.3) == []
         assert not c.binder.binds
 
+    def test_idle_queue_without_jobs_does_not_crash(self):
+        # proportion builds queue attrs only for job-bearing queues
+        # (reference proportion.go:66-99), and the greedy loop discovers
+        # queues from jobs — so a tenant queue created ahead of its
+        # first jobs must not crash tensorize's queue ordering
+        # (regression: every allocate_tpu cycle KeyError'd on the idle
+        # queue in the multitenant perf scenario).
+        c = make_cache()
+        c.add_queue(build_queue("default", weight=1))
+        c.add_queue(build_queue("tenant-b", weight=3))  # no jobs yet
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=1))
+        c.add_pod(build_pod("ns", "p0", "", PodPhase.PENDING, req(),
+                            group_name="pg1"))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+
+        run_action(c, "allocate_tpu")
+        binds = drain(c.binder.channel, 1)
+        assert len(binds) == 1
+
     def test_two_jobs_share_cluster(self):
         c = make_cache()
         c.add_queue(build_queue("default"))
