@@ -115,6 +115,9 @@ class ReclaimAction(Action):
         # per-claimant pass WAS reclaim throughput (perf-multitenant
         # r4). Staleness rules live in FeasibilityMemo.
         memo = FeasibilityMemo(ssn)
+        # Cycle-scoped per-queue exhausted-node memo (see the victim
+        # scan below for the monotonicity argument).
+        no_victims: dict = {}
 
         while not queues.empty():
             queue = queues.pop()
@@ -198,10 +201,45 @@ class ReclaimAction(Action):
                 continue
 
             assigned = False
+            exhausted = no_victims.setdefault(job.queue, set())
             for node in feasible:
+                # Memo soundness: within a cycle, verdicts in the
+                # default reclaim chain move DOWN on evictions
+                # (proportion's per-queue over-deserved quota shrinks,
+                # gang's minAvailable floors approach, conformance is
+                # static), so a node that yielded zero victims stays
+                # victimless — UNLESS a successful pipeline raises some
+                # claimant queue's allocated above its deserved share,
+                # which can newly expose THAT queue's running tasks as
+                # victims. A pipeline of queue Q therefore invalidates
+                # the memos of every claimant queue EXCEPT Q (Q's own
+                # claimants reclaim from queues whose availability only
+                # shrank). With a single starving queue — and in the
+                # saturated stall phase, where a backlog of claimants
+                # re-evaluated every floored job on every node each
+                # wave (measured 1.17M evictable calls per cycle at 1k
+                # nodes under a scattered placement) — the memo
+                # persists exactly where it pays.
+                if node.name in exhausted:
+                    continue  # see memo soundness note below
                 resreq = task.init_resreq.clone()
                 reclaimed = Resource.empty()
 
+                # Candidates are the live node-task objects — the
+                # reclaimable chain only filters (proportion/gang/
+                # conformance read), so cloning every RUNNING task per
+                # (claimant, node) pair (~18M clones per saturated 1k-
+                # node cycle) buys nothing HERE. The clone happens at
+                # EVICT time instead, and is load-bearing there:
+                # session.evict flips the task's status before
+                # node.update_task, and NodeInfo.remove_task derives the
+                # removal delta from its stored task's CURRENT status —
+                # evicting the node's own object would erase the
+                # RUNNING→RELEASING capacity move, the claimant's
+                # pipeline would miss the released capacity, and the
+                # next cycle would evict again (observed as doubling
+                # every reclaim wave). Reference analog: reclaim.go:96
+                # clones at candidate-build time.
                 reclaimees = []
                 for t in node.tasks.values():
                     if t.status != TaskStatus.RUNNING:
@@ -210,9 +248,10 @@ class ReclaimAction(Action):
                     if j is None:
                         continue
                     if j.queue != job.queue:
-                        reclaimees.append(t.clone())
+                        reclaimees.append(t)
                 victims = ssn.reclaimable(task, reclaimees)
                 if not victims:
+                    exhausted.add(node.name)
                     continue
 
                 all_res = Resource.empty()
@@ -222,6 +261,10 @@ class ReclaimAction(Action):
                     continue
 
                 for reclaimee in victims:
+                    # Clone HERE (see the candidate-build comment): the
+                    # eviction must not mutate the node's stored object
+                    # before node accounting reads its pre-evict status.
+                    reclaimee = reclaimee.clone()
                     try:
                         ssn.evict(reclaimee, "reclaim")
                     except Exception:
@@ -237,6 +280,13 @@ class ReclaimAction(Action):
                 if task.init_resreq.less_equal(reclaimed):
                     try:
                         ssn.pipeline(task, node.name)
+                        # The pipeline raised THIS queue's allocated —
+                        # only other claimant queues' verdicts about
+                        # its tasks can flip up (soundness note at the
+                        # scan above).
+                        for quid in list(no_victims):
+                            if quid != job.queue:
+                                del no_victims[quid]
                     except Exception:
                         # Corrected in next scheduling loop (reclaim.go:173-180)
                         logger.exception(

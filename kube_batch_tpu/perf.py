@@ -390,6 +390,9 @@ def run_multitenant_compare(**kw) -> Dict:
     def p(art, q):
         return art["dataItems"][0][q]
 
+    def complete(art):
+        return art["tenant_b_running"] == art["config"]["tenant_b_pods"]
+
     artifact = dict(tpu)
     artifact["metric"] = "multitenant_reclaim_compare"
     artifact["reference_loop"] = {
@@ -401,14 +404,28 @@ def run_multitenant_compare(**kw) -> Dict:
         "wall_seconds": ref["wall_seconds"],
         "dataItems": ref["dataItems"],
     }
+    # Percentiles of a run that hit its deadline cover only the pods
+    # that made it — comparing a censored distribution against a
+    # complete one would flatter the censored side. Ratios only when
+    # both runs admitted every tenant-b pod.
     artifact["comparison"] = {
-        "tenant_b_admission_p50_speedup": round(
-            p(ref, "Perc50") / p(tpu, "Perc50"), 3
-        ) if p(tpu, "Perc50") else None,
-        "tenant_b_admission_p99_speedup": round(
-            p(ref, "Perc99") / p(tpu, "Perc99"), 3
-        ) if p(tpu, "Perc99") else None,
+        "tpu_admission_complete": complete(tpu),
+        "reference_admission_complete": complete(ref),
     }
+    if complete(tpu) and complete(ref):
+        artifact["comparison"].update({
+            "tenant_b_admission_p50_speedup": round(
+                p(ref, "Perc50") / p(tpu, "Perc50"), 3
+            ) if p(tpu, "Perc50") else None,
+            "tenant_b_admission_p99_speedup": round(
+                p(ref, "Perc99") / p(tpu, "Perc99"), 3
+            ) if p(tpu, "Perc99") else None,
+        })
+    else:
+        artifact["comparison"]["incomparable_reason"] = (
+            "a run hit its convergence deadline before admitting every "
+            "tenant-b pod; its percentiles are censored"
+        )
     return artifact
 
 

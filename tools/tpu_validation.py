@@ -24,6 +24,10 @@ import subprocess
 import sys
 import time
 
+# Build-round suffix for committed trace artifacts; bump per round so
+# evidence files carry their provenance.
+ROUND = "r5"
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -97,7 +101,7 @@ print(json.dumps({
 
 def run_traced_bench(trace_dir, timeout=1800):
     """Headline bench with a jax.profiler trace captured into trace_dir,
-    then compressed to a committable artifact (traces/tpu_trace_r4.tar.gz)
+    then compressed to a committable artifact (traces/tpu_trace_<round>.tar.gz)
     so the device-compute decomposition is backed by evidence in-repo."""
     import shutil
     import tarfile
@@ -116,9 +120,9 @@ def run_traced_bench(trace_dir, timeout=1800):
         if proc.returncode == 0 and os.path.isdir(trace_dir):
             out = os.path.join(REPO, "traces")
             os.makedirs(out, exist_ok=True)
-            tar_path = os.path.join(out, "tpu_trace_r4.tar.gz")
+            tar_path = os.path.join(out, f"tpu_trace_{ROUND}.tar.gz")
             with tarfile.open(tar_path, "w:gz") as tar:
-                tar.add(trace_dir, arcname="tpu_trace_r4")
+                tar.add(trace_dir, arcname=f"tpu_trace_{ROUND}")
             result["trace_artifact"] = os.path.relpath(tar_path, REPO)
             # Only the tarball is meant for the repo; leaving the raw
             # profile next to it invites `git add traces/` to stage it.
@@ -209,7 +213,7 @@ def main():
         # Headline large run doubles as the profiler-trace capture; the
         # compressed trace lands in traces/ as a committable artifact.
         report["bench"]["large"] = run_traced_bench(
-            os.path.join(REPO, "traces", "r4_profile"), timeout=1800
+            os.path.join(REPO, "traces", f"{ROUND}_profile"), timeout=1800
         )
         report["bench_pallas_large"] = run_bench(
             "large", env_extra={"KBT_PALLAS": "1"}, timeout=1500
