@@ -242,6 +242,60 @@ class TestReclaim:
         assert len(evicts) == 1
         assert evicts[0].startswith("ns/pg1-p")
 
+    def test_eviction_moves_capacity_to_releasing_and_pipelines(self):
+        # Regression (r5): reclaim must evict a CLONE, not the node's
+        # stored task object — session.evict flips status before
+        # node.update_task, and NodeInfo.remove_task derives its delta
+        # from the stored task's CURRENT status, so evicting the stored
+        # object erased the RUNNING→RELEASING capacity move. Observable
+        # contract: after reclaim, the victim's capacity sits in
+        # node.releasing and the claimant is PIPELINED onto it in the
+        # same cycle (not re-evicting next cycle).
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=1))
+        c.add_queue(build_queue("q2", weight=1))
+        c.add_node(build_node("n1", build_resource_list(cpu="2", memory="4Gi")))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=1,
+                                        queue="q1"))
+        for i in range(2):
+            c.add_pod(build_pod("ns", f"pg1-p{i}", "n1", PodPhase.RUNNING,
+                                req(), group_name="pg1"))
+        c.add_pod_group(build_pod_group("pg2", namespace="ns", min_member=1,
+                                        queue="q2"))
+        c.add_pod(build_pod("ns", "pg2-p0", "", PodPhase.PENDING, req(),
+                            group_name="pg2"))
+
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        ssn = open_session(c, tiers)
+        action, found = get_action("reclaim")
+        assert found
+        action.execute(ssn)
+        try:
+            evicts = drain(c.evictor.channel, 1)
+            assert len(evicts) == 1
+            # The claimant pipelined onto the released capacity in the
+            # SAME cycle (no next-cycle re-eviction), and the session
+            # mirror is consistent: one victim RELEASING, one RUNNING.
+            claimant = next(iter(ssn.jobs["ns/pg2"].tasks.values()))
+            assert claimant.status == TaskStatus.PIPELINED
+            assert claimant.node_name == "n1"
+            statuses = sorted(
+                t.status.name for t in ssn.jobs["ns/pg1"].tasks.values()
+            )
+            assert statuses == ["RELEASING", "RUNNING"]
+            # Node accounting: the victim's RUNNING→RELEASING move
+            # produced releasing capacity and the pipeline consumed
+            # exactly it (broken eviction left releasing at 0 BEFORE
+            # the pipeline, which then failed — caught by the PIPELINED
+            # assert above); the victim still physically occupies the
+            # node until deletion, so used covers victim + survivor +
+            # pipelined claimant.
+            node = ssn.nodes["n1"]
+            assert node.releasing.milli_cpu == 0
+            assert node.used.milli_cpu == 3000
+        finally:
+            close_session(ssn)
+
     def test_heterogeneous_gang_sim_respects_member_predicates(self):
         # The skip-eviction guard simulates the CLAIMANT's whole gang onto
         # free capacity. With per-member node selectors, a node only the
