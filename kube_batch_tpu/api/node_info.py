@@ -46,6 +46,12 @@ class NodeInfo:
         # Mutation counter for the cache's COW snapshot pool (see
         # JobInfo._ver): bumped by every accounting mutator.
         self._ver = 0
+        # Generation of the backing k8s object: bumped ONLY when a
+        # watch update lands (set_node) — including in-place mutations
+        # re-delivered as the same reference (InProcessCluster does
+        # this). Keys the predicates plugin's static-node-verdict memo;
+        # _ver cannot (it bumps on every bind).
+        self._node_obj_ver = 0
         if node is not None:
             self.name = node.name
             self.node = node
@@ -76,6 +82,7 @@ class NodeInfo:
         """Recompute accounting from a fresh node object
         (reference node_info.go:134-159)."""
         self._ver += 1
+        self._node_obj_ver += 1
         self._set_node_state(node)
         if not self.ready():
             return
@@ -268,6 +275,7 @@ class NodeInfo:
         quantity strings on every 1 Hz snapshot."""
         res = NodeInfo.__new__(NodeInfo)
         res._ver = 0
+        res._node_obj_ver = self._node_obj_ver
         res.name = self.name
         res.node = self.node
         res.state = NodeState(self.state.phase, self.state.reason)

@@ -241,25 +241,69 @@ class PredicatesPlugin(Plugin):
 
             T, N = len(tasks), len(nodes)
 
+            # Node column: the static verdict (conditions, cordon,
+            # pressure gates, has-taints) reads only the immutable
+            # watch object, so it is memoized on node.node keyed by the
+            # pressure-flag combo — a watch update replaces the object
+            # and invalidates naturally, exactly like the pod spec memo
+            # below. Only the pod-count cap stays live per cycle. This
+            # loop runs over EVERY node EVERY cycle (it was most of the
+            # 1%-delta tensorize floor at 5k nodes).
             node_ok = np.ones(N, dtype=bool)
             tainted: List[int] = []
+            flags = (mem_enable, disk_enable, pid_enable)
             for j, node in enumerate(nodes):
-                try:
-                    check_node_condition(None, node)
-                    check_node_unschedulable(None, node)
-                    if mem_enable:
-                        _check_pressure(node, "MemoryPressure", "x")
-                    if disk_enable:
-                        _check_pressure(node, "DiskPressure", "x")
-                    if pid_enable:
-                        _check_pressure(node, "PIDPressure", "x")
-                except PredicateError:
-                    node_ok[j] = False
-                    continue
+                knode = node.node
+                if knode is None:
+                    # No backing object: evaluate directly (the checks
+                    # define the Unknown-condition semantics).
+                    try:
+                        check_node_condition(None, node)
+                        check_node_unschedulable(None, node)
+                    except PredicateError:
+                        node_ok[j] = False
+                        continue
+                    has_taints = False
+                else:
+                    # Unlike pod specs, node specs/conditions are
+                    # MUTABLE: the memo key carries id(owner) — a
+                    # copied object (copy.deepcopy in tests/tools)
+                    # inherits the attr but its own id never matches —
+                    # AND the NodeInfo's watch-object generation
+                    # (bumped by set_node), which catches an in-place
+                    # mutation re-delivered as the SAME reference
+                    # (InProcessCluster.update does exactly that).
+                    gen = node._node_obj_ver
+                    cached = knode.__dict__.get("_node_pred")
+                    if (
+                        cached is None
+                        or cached[0] != (flags, id(knode), gen)
+                    ):
+                        ok = True
+                        try:
+                            check_node_condition(None, node)
+                            check_node_unschedulable(None, node)
+                            if mem_enable:
+                                _check_pressure(node, "MemoryPressure", "x")
+                            if disk_enable:
+                                _check_pressure(node, "DiskPressure", "x")
+                            if pid_enable:
+                                _check_pressure(node, "PIDPressure", "x")
+                        except PredicateError:
+                            ok = False
+                        cached = knode._node_pred = (
+                            (flags, id(knode), gen),
+                            ok,
+                            bool(knode.spec.taints),
+                        )
+                    if not cached[1]:
+                        node_ok[j] = False
+                        continue
+                    has_taints = cached[2]
                 if 0 < node.allocatable.max_task_num <= len(node.tasks):
                     node_ok[j] = False
                     continue
-                if node.node is not None and node.node.spec.taints:
+                if has_taints:
                     tainted.append(j)
 
             def _terms_sig(terms):

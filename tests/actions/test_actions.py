@@ -169,6 +169,55 @@ class TestAllocate:
         assert q2_binds == 1
 
 
+class TestNodePredicateMemoInvalidation:
+    def test_cordoned_node_excluded_after_update(self):
+        # The static node verdict is memoized on the watch object
+        # (predicates.py batch pass); a node update replaces the object
+        # (NodeInfo.set_node), so cordoning between cycles must take
+        # effect on the next cycle's mask.
+        import copy
+
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        node = build_node("n1", build_resource_list(cpu="4", memory="8Gi"))
+        c.add_node(node)
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=1))
+        c.add_pod(build_pod("ns", "p0", "", PodPhase.PENDING, req(),
+                            group_name="pg1"))
+        run_action(c, "allocate_tpu")
+        assert drain(c.binder.channel, 1) == ["ns/p0"]
+
+        # Cordon via a FRESH object, as a real watch update delivers it.
+        cordoned = copy.deepcopy(node)
+        cordoned.spec.unschedulable = True
+        c.update_node(node, cordoned)
+        c.add_pod(build_pod("ns", "p1", "", PodPhase.PENDING, req(),
+                            group_name="pg1"))
+        run_action(c, "allocate_tpu")
+        assert drain(c.binder.channel, 1, timeout=0.3) == []
+
+    def test_inplace_mutation_same_reference_invalidates(self):
+        # InProcessCluster.update re-delivers the SAME object reference
+        # after in-place mutation; the memo must invalidate via the
+        # NodeInfo watch-object generation, not object identity.
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        node = build_node("n1", build_resource_list(cpu="4", memory="8Gi"))
+        c.add_node(node)
+        c.add_pod_group(build_pod_group("pg1", namespace="ns", min_member=1))
+        c.add_pod(build_pod("ns", "p0", "", PodPhase.PENDING, req(),
+                            group_name="pg1"))
+        run_action(c, "allocate_tpu")
+        assert drain(c.binder.channel, 1) == ["ns/p0"]
+
+        node.spec.unschedulable = True          # in-place
+        c.update_node(node, node)               # same reference
+        c.add_pod(build_pod("ns", "p1", "", PodPhase.PENDING, req(),
+                            group_name="pg1"))
+        run_action(c, "allocate_tpu")
+        assert drain(c.binder.channel, 1, timeout=0.3) == []
+
+
 class TestBackfill:
     def test_besteffort_pod_backfilled(self):
         c = make_cache()
