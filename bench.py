@@ -285,6 +285,11 @@ def bench_cycle(cfg, seed=0, cache=None):
 
     Each cycle reports open/tensorize/solve/apply/epilogue/close phases
     (from actions.allocate_tpu.last_stats) plus the e2e wall time.
+    Attribution flags ride along per cycle: ``apply_handlers_batched``
+    / ``apply_job_groups_hint`` (aggregate plugin handler dispatch) and
+    ``tensorize_incremental`` / ``tensorize_dirty_nodes`` /
+    ``tensorize_full_reason`` (incremental snapshot patching and the
+    row counts it actually touched).
     """
     from kube_batch_tpu.actions import allocate_tpu as _atpu
 

@@ -106,6 +106,12 @@ class AllocateTpuAction(Action):
         t0 = time.perf_counter()
         inputs, ctx = tensorize(ssn, device=not use_native)
         _record_phase("tensorize", (time.perf_counter() - t0) * 1e3)
+        # Incremental-tensorize forensics (dirty-row counts, fallback
+        # reasons) for the bench/BENCH attribution.
+        from ..solver.snapshot import last_tensorize_stats
+
+        for k, v in last_tensorize_stats.items():
+            last_stats[f"tensorize_{k}"] = v
         if inputs is None:
             return
 
@@ -153,7 +159,8 @@ class AllocateTpuAction(Action):
             nodes_sel = a[sel]
             order = np.argsort(nodes_sel, kind="stable")
             nodes_sorted = nodes_sel[order]
-            req_rows = ctx.task_req_host[sel][order]
+            req_sel = ctx.task_req_host[sel]  # shared with the job view
+            req_rows = req_sel[order]
             fit_rows = ctx.task_fit_host[sel][order]
             cum = np.cumsum(req_rows, axis=0)
             seg_starts = np.nonzero(
@@ -173,28 +180,54 @@ class AllocateTpuAction(Action):
                 # per-task dict passes, and each group carries its
                 # aggregate resreq delta (a cumsum difference) so node
                 # accounting skips per-task Resource math too.
-                tasks_sorted = [
-                    ctx.tasks[i] for i in sel[order].tolist()
-                ]
-                seg_list = seg_starts.tolist()
-                seg_ends = seg_list[1:] + [len(tasks_sorted)]
-                zero = np.zeros_like(cum[0])
                 layout = ctx.layout
                 mib = 1024.0 * 1024.0
-                node_groups = []
-                for s, e in zip(seg_list, seg_ends):
-                    row = cum[e - 1] - (cum[s - 1] if s else zero)
+
+                def row_to_resource(row):
                     delta = Resource(row[0], row[1] * mib)
                     for k, name in enumerate(layout.scalars):
                         v = float(row[2 + k])
                         if v:
                             delta.add_scalar(name, v)
+                    return delta
+
+                getter = ctx.tasks.__getitem__
+                tasks_sorted = list(map(getter, sel[order].tolist()))
+                seg_list = seg_starts.tolist()
+                seg_ends = seg_list[1:] + [len(tasks_sorted)]
+                zero = np.zeros_like(cum[0])
+                node_groups = []
+                for s, e in zip(seg_list, seg_ends):
+                    row = cum[e - 1] - (cum[s - 1] if s else zero)
                     node_groups.append((
                         ctx.nodes[int(nodes_sorted[s])].name,
                         tasks_sorted[s:e],
-                        delta,
+                        row_to_resource(row),
                     ))
-                placed = ssn.allocate_batch_grouped(node_groups)
+                # Per-JOB groups with aggregate resreq deltas, same
+                # cumsum-difference trick on a job-sorted view: the
+                # session's apply tail then runs ~#jobs aggregate
+                # updates (status-index move, job.allocated, plugin
+                # batch handlers) instead of 50k per-task passes.
+                job_idx = np.asarray(
+                    ctx.host_inputs.task_job[:T]
+                )[sel]
+                jorder = np.argsort(job_idx, kind="stable")
+                jtasks = list(map(getter, sel[jorder].tolist()))
+                jcum = np.cumsum(req_sel[jorder], axis=0)
+                jstarts = np.nonzero(
+                    np.diff(job_idx[jorder], prepend=-1)
+                )[0].tolist()
+                jends = jstarts[1:] + [len(jtasks)]
+                job_groups = []
+                for s, e in zip(jstarts, jends):
+                    row = jcum[e - 1] - (jcum[s - 1] if s else zero)
+                    job_groups.append((
+                        jtasks[s].job, jtasks[s:e], row_to_resource(row)
+                    ))
+                placed = ssn.allocate_batch_grouped(
+                    node_groups, job_groups=job_groups
+                )
             else:
                 placed = 0
         else:
@@ -238,12 +271,22 @@ class AllocateTpuAction(Action):
         #
         # Only nodes that actually hold Releasing capacity can take a
         # pipeline; in the common no-eviction cycle that set is empty and
-        # the whole O(leftovers x nodes) pass is skipped.
-        releasing_nodes = [
-            (j, ssn.nodes[node.name])
-            for j, node in enumerate(ctx.nodes)
-            if not ssn.nodes[node.name].releasing.is_empty()
-        ]
+        # the whole O(leftovers x nodes) pass is skipped. Candidates are
+        # narrowed with one numpy pass over the snapshot's releasing
+        # matrix (releasing only accumulates task resreqs, whose dims are
+        # always in the layout, so a non-empty releasing always has a
+        # nonzero row) — the per-node Python walk cost ~10 ms at 5k
+        # nodes on every cycle, releasing or not.
+        releasing_nodes = []
+        if ctx.has_releasing:
+            rel_rows = np.asarray(
+                ctx.host_inputs.node_releasing[: len(ctx.nodes)]
+            )
+            releasing_nodes = [
+                (j, ssn.nodes[ctx.nodes[j].name])
+                for j in np.nonzero(rel_rows.any(axis=1))[0].tolist()
+                if not ssn.nodes[ctx.nodes[j].name].releasing.is_empty()
+            ]
         leftovers = enumerate(ctx.tasks) if releasing_nodes else ()
         for i, task in leftovers:
             if int(assigned[i]) >= 0:

@@ -18,6 +18,14 @@ from ..utils.scheduler_helper import FeasibilityMemo
 
 logger = logging.getLogger(__name__)
 
+# Reclaimable fns audited against the exhausted-node memo's soundness
+# contract (claimant-independent + eviction-monotone — see
+# Session.add_reclaimable_fn). A reclaimable plugin OUTSIDE this set
+# disables the memo for the cycle: an upstream-style
+# priority-vs-claimant verdict could flip a node from victimless to
+# victim-bearing for a later claimant, which the memo would hide.
+MEMO_SAFE_RECLAIMABLE = frozenset({"proportion", "gang", "conformance"})
+
 
 class ReclaimAction(Action):
     def name(self) -> str:
@@ -116,7 +124,23 @@ class ReclaimAction(Action):
         # r4). Staleness rules live in FeasibilityMemo.
         memo = FeasibilityMemo(ssn)
         # Cycle-scoped per-queue exhausted-node memo (see the victim
-        # scan below for the monotonicity argument).
+        # scan below for the monotonicity argument). Gated on the
+        # enabled reclaimable plugin set: only fns audited against the
+        # contract at Session.add_reclaimable_fn may feed it.
+        enabled_reclaimable = {
+            plugin.name
+            for tier in ssn.tiers
+            for plugin in tier.plugins
+            if bool(getattr(plugin, "enabled_reclaimable", False))
+            and plugin.name in ssn.reclaimable_fns
+        }
+        memo_enabled = enabled_reclaimable <= MEMO_SAFE_RECLAIMABLE
+        if not memo_enabled:
+            logger.info(
+                "reclaimable plugins %s outside the audited set %s; "
+                "running without the exhausted-node memo",
+                sorted(enabled_reclaimable), sorted(MEMO_SAFE_RECLAIMABLE),
+            )
         no_victims: dict = {}
 
         while not queues.empty():
@@ -220,7 +244,7 @@ class ReclaimAction(Action):
                 # wave (measured 1.17M evictable calls per cycle at 1k
                 # nodes under a scattered placement) — the memo
                 # persists exactly where it pays.
-                if node.name in exhausted:
+                if memo_enabled and node.name in exhausted:
                     continue  # see memo soundness note below
                 resreq = task.init_resreq.clone()
                 reclaimed = Resource.empty()
@@ -251,7 +275,8 @@ class ReclaimAction(Action):
                         reclaimees.append(t)
                 victims = ssn.reclaimable(task, reclaimees)
                 if not victims:
-                    exhausted.add(node.name)
+                    if memo_enabled:
+                        exhausted.add(node.name)
                     continue
 
                 all_res = Resource.empty()
@@ -260,22 +285,30 @@ class ReclaimAction(Action):
                 if all_res.less(resreq):
                     continue
 
+                # Minimal victim prefix covering the claim, then ONE
+                # batched eviction: bulk RELEASING moves per job +
+                # aggregate deallocate handlers (Session.evict_batch)
+                # instead of per-victim handler fan-out. Clone HERE
+                # (see the candidate-build comment): the eviction must
+                # not mutate the node's stored object before node
+                # accounting reads its pre-evict status. Divergence
+                # from the sequential loop only in the rare
+                # evict-failure case: the per-task loop would try the
+                # NEXT victim to make up the shortfall, the batch
+                # settles for what succeeded and lets the next cycle
+                # correct — the reference's own self-correction
+                # contract (reclaim.go:173-180).
+                chosen = []
                 for reclaimee in victims:
-                    # Clone HERE (see the candidate-build comment): the
-                    # eviction must not mutate the node's stored object
-                    # before node accounting reads its pre-evict status.
-                    reclaimee = reclaimee.clone()
-                    try:
-                        ssn.evict(reclaimee, "reclaim")
-                    except Exception:
-                        logger.exception(
-                            "Failed to reclaim Task <%s/%s>",
-                            reclaimee.namespace, reclaimee.name,
-                        )
-                        continue
+                    chosen.append(reclaimee.clone())
                     reclaimed.add(reclaimee.resreq)
                     if resreq.less_equal(reclaimed):
                         break
+                evicted = ssn.evict_batch(chosen, "reclaim")
+                if len(evicted) != len(chosen):
+                    reclaimed = Resource.empty()
+                    for t in evicted:
+                        reclaimed.add(t.resreq)
 
                 if task.init_resreq.less_equal(reclaimed):
                     try:

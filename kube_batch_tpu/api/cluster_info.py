@@ -19,6 +19,15 @@ class ClusterInfo:
         self.jobs: Dict[JobID, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[QueueID, QueueInfo] = {}
+        # Names of jobs/nodes the cache mirror touched since the
+        # PREVIOUS snapshot (stamped by the watch/bind event handlers,
+        # drained by SchedulerCache.snapshot). Observability for the
+        # incremental tensorize path: the authoritative row-level
+        # dirtiness is the clone fingerprints (a session can mutate its
+        # clones after snapshot time), but these sets attribute WHERE
+        # churn came from and size the expected patch work.
+        self.dirty_jobs: frozenset = frozenset()
+        self.dirty_nodes: frozenset = frozenset()
 
     def __repr__(self) -> str:
         return (

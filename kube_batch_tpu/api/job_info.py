@@ -248,14 +248,24 @@ class JobInfo:
         self._add_task_index(task)
 
     def update_tasks_status(
-        self, tasks: List[TaskInfo], status: TaskStatus
+        self,
+        tasks: List[TaskInfo],
+        status: TaskStatus,
+        resreq_delta: "Resource" = None,
     ) -> None:
         """Bulk :meth:`update_task_status` toward one destination status.
         Per-task semantics are identical (clones and missing tasks take
         the per-task path, including its KeyError); the stored-task fast
         path amortizes the version bump, the target-index lookup, and the
         empty-source-bucket cleanup across the whole group — this runs 3x
-        per placement on the apply path, 150k calls per 50k-task cycle."""
+        per placement on the apply path, 150k calls per 50k-task cycle.
+
+        ``resreq_delta``, when given, must be the EXACT sum of the
+        group's resreqs; a status flip on the whole-bucket fast path
+        then updates ``self.allocated`` with one aggregate add/sub
+        instead of one per task (exact for integral milli/byte
+        quantities — same argument as the node accounting aggregates).
+        The per-task fallback paths ignore it and keep per-task math."""
         if not tasks:
             return
         self._ver += 1
@@ -296,7 +306,12 @@ class JobInfo:
                     was = allocated_status(src_status)
                     if was != now:
                         agg = self.allocated
-                        if now:
+                        if resreq_delta is not None:
+                            if now:
+                                agg.add(resreq_delta)
+                            else:
+                                agg.sub(resreq_delta)
+                        elif now:
                             for t in tasks:
                                 agg.add(t.resreq)
                         else:
@@ -330,6 +345,52 @@ class JobInfo:
             bucket = self.task_status_index.get(src_status)
             if bucket is not None and not bucket:
                 del self.task_status_index[src_status]
+
+    def move_status_bucket(
+        self,
+        src: TaskStatus,
+        dst: TaskStatus,
+        resreq_delta: "Resource" = None,
+    ) -> List[TaskInfo]:
+        """Move the ENTIRE ``src`` status bucket to ``dst`` — the
+        trusted bulk form of :meth:`update_tasks_status` for callers
+        that already hold the whole bucket (the batched apply path moves
+        a job's complete PENDING set to ALLOCATED and its complete
+        ALLOCATED set to BINDING). Skips the per-task stored-identity
+        verification (the bucket's values ARE the stored tasks by
+        construction) and, when the transition flips allocated-status,
+        applies ``resreq_delta`` (or a per-task fold) once. Returns the
+        moved tasks; no-op empty list when the bucket is missing."""
+        bucket = self.task_status_index.get(src)
+        if not bucket:
+            return []
+        validate_status_update(src, dst)
+        self._ver += 1
+        was, now = allocated_status(src), allocated_status(dst)
+        if was != now:
+            agg = self.allocated
+            if resreq_delta is not None:
+                if now:
+                    agg.add(resreq_delta)
+                else:
+                    agg.sub(resreq_delta)
+            elif now:
+                for t in bucket.values():
+                    agg.add(t.resreq)
+            else:
+                for t in bucket.values():
+                    agg.sub(t.resreq)
+        del self.task_status_index[src]
+        target = self.task_status_index.get(dst)
+        if target is None:
+            # Reuse the bucket dict itself: no per-task re-inserts.
+            self.task_status_index[dst] = bucket
+        else:
+            target.update(bucket)
+        moved = list(bucket.values())
+        for t in moved:
+            t.status = dst
+        return moved
 
     def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
         """Clones of all tasks in the given statuses (reference :210-222)."""

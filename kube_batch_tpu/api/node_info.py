@@ -15,7 +15,7 @@ import logging
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-from .helpers import pod_key
+from .helpers import POD_KEY_CACHE_ATTR, pod_key
 from .job_info import TaskInfo
 from .objects import Node, Pod
 from .resource_info import Resource
@@ -35,6 +35,15 @@ class NodeInfo:
 
     def __init__(self, node: Optional[Node] = None):
         self.name = ""
+        # The backing k8s Node object. CONTRACT: in-place mutations of
+        # this object (spec/conditions/labels/taints) are invisible to
+        # the predicates plugin's static-verdict memo, which keys on
+        # (id(node), _node_obj_ver) — deliver every change through
+        # :meth:`set_node` (the watch ingest path does), even when
+        # re-delivering the same object reference, so the generation
+        # bumps and the memo re-evaluates. Code that tweaks
+        # ``node_info.node`` directly between cycles will keep serving
+        # the stale verdict indefinitely.
         self.node: Optional[Node] = None
         self.state = NodeState()
         self.releasing = Resource.empty()
@@ -80,7 +89,11 @@ class NodeInfo:
 
     def set_node(self, node: Node) -> None:
         """Recompute accounting from a fresh node object
-        (reference node_info.go:134-159)."""
+        (reference node_info.go:134-159). This is the ONLY path that
+        bumps ``_node_obj_ver`` — any in-place mutation of the backing
+        object must be re-delivered through here to be observed by the
+        predicates static-verdict memo (see the ``node`` attribute
+        contract in ``__init__``)."""
         self._ver += 1
         self._node_obj_ver += 1
         self._set_node_state(node)
@@ -191,14 +204,28 @@ class NodeInfo:
             return
         new = {}
         node_tasks = self.tasks
+        setdefault = new.setdefault
         for task in tasks:
-            key = pod_key(task.pod)
-            if key in node_tasks or key in new:
+            # Inline pod_key incl. its memo write: the function-call
+            # overhead alone was measurable at 50k tasks per apply, and
+            # the cold burst is exactly the first touch of every pod.
+            pod = task.pod
+            key = pod.__dict__.get(POD_KEY_CACHE_ATTR)
+            if key is None:
+                key = pod.metadata.uid or f"{pod.namespace}/{pod.name}"
+                pod.__dict__[POD_KEY_CACHE_ATTR] = key
+            # setdefault doubles as the intra-batch duplicate check.
+            if key in node_tasks or setdefault(key, task) is not task:
                 raise ValueError(
                     f"task <{task.namespace}/{task.name}> already on "
                     f"node <{self.name}>"
                 )
-            new[key] = task
+        if len(new) != len(tasks):
+            # Same task object listed twice slips past setdefault.
+            raise ValueError(
+                f"duplicate tasks in prevalidated batch for "
+                f"node <{self.name}>"
+            )
         if self.node is not None:
             if not delta.less_equal(self.idle):
                 raise ValueError(

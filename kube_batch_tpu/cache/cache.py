@@ -180,6 +180,12 @@ class SchedulerCache(Cache, EventHandlersMixin):
         # COW snapshot pool: {key: (src_ver, clone, clone_ver)} per kind
         # (see snapshot()).
         self._snap_pool: tuple = ({}, {})
+        # Job/node names touched since the last snapshot (stamped by the
+        # event handlers and the bind bookkeeping under the mutex,
+        # drained into ClusterInfo.dirty_jobs/dirty_nodes by snapshot()):
+        # the cheap churn ledger the incremental tensorize stats report.
+        self._dirty_jobs: set = set()
+        self._dirty_nodes: set = set()
 
         self._executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="cache-sideeffect"
@@ -436,6 +442,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 snap.jobs[key] = entry[1]
             # Entries for deleted objects fall away with the pool swap.
             self._snap_pool = (pool_jobs, pool_nodes)
+            snap.dirty_jobs = frozenset(self._dirty_jobs)
+            snap.dirty_nodes = frozenset(self._dirty_nodes)
+            self._dirty_jobs.clear()
+            self._dirty_nodes.clear()
             return snap
 
     # -- side effects --------------------------------------------------------
@@ -469,6 +479,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 f"failed to bind Task {task.uid} to host {hostname}: "
                 f"host does not exist"
             )
+        self._stamp_dirty(task_info.job, hostname)
         if task.status not in (TaskStatus.PENDING, TaskStatus.ALLOCATED):
             raise ValueError(
                 f"failed to bind Task {task.uid}: status is "
@@ -718,6 +729,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     f"failed to evict Task {task.uid}: host {task.node_name} "
                     f"does not exist"
                 )
+            self._stamp_dirty(task_info.job, task.node_name)
             job.update_task_status(task, TaskStatus.RELEASING)
             node.update_task(task)
             pod = task.pod
@@ -741,29 +753,35 @@ class SchedulerCache(Cache, EventHandlersMixin):
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         self.volume_binder.allocate_volumes(task, hostname)
 
-    def allocate_volumes_batch(self, tasks, hostname: str) -> list:
+    def allocate_volumes_batch(
+        self, tasks, hostname: str, assign_node_name: bool = False
+    ) -> list:
         """Batched :meth:`allocate_volumes` for one node's group.
         Claims-less pods (the overwhelming majority) are marked ready in
         one tight loop without a seam call per task; only claim-bearing
         pods go through the per-task binder. Returns the tasks whose
         volume allocation succeeded (failures logged and skipped, like
-        the sequential apply loop)."""
+        the sequential apply loop). ``assign_node_name`` additionally
+        stamps ``task.node_name = hostname`` on each successful task —
+        the apply path otherwise paid a second full pass for it."""
         ok = []
+        append = ok.append
         allocate = self.volume_binder.allocate_volumes
         for task in tasks:
-            if not task.pod.spec.volume_claims:
+            if task.pod.spec.volume_claims:
+                try:
+                    allocate(task, hostname)
+                except Exception:
+                    logger.exception(
+                        "Failed to allocate volumes of Task %s on %s",
+                        task.uid, hostname,
+                    )
+                    continue
+            else:
                 task.volume_ready = True
-                ok.append(task)
-                continue
-            try:
-                allocate(task, hostname)
-            except Exception:
-                logger.exception(
-                    "Failed to allocate volumes of Task %s on %s",
-                    task.uid, hostname,
-                )
-                continue
-            ok.append(task)
+            if assign_node_name:
+                task.node_name = hostname
+            append(task)
         return ok
 
     def bind_volumes(self, task: TaskInfo) -> None:

@@ -35,7 +35,19 @@ def _is_terminated(status: TaskStatus) -> bool:
 
 
 class EventHandlersMixin:
-    """Handler methods mixed into SchedulerCache."""
+    """Handler methods mixed into SchedulerCache.
+
+    Every mutation additionally stamps the touched job/node name into
+    the cache's dirty ledger (``_dirty_jobs`` / ``_dirty_nodes``,
+    drained by ``snapshot()`` into the ClusterInfo) so the incremental
+    tensorize path can report how much churn arrived between cycles."""
+
+    def _stamp_dirty(self, job_key: Optional[str] = None,
+                     node_name: Optional[str] = None) -> None:
+        if job_key:
+            self._dirty_jobs.add(job_key)
+        if node_name:
+            self._dirty_nodes.add(node_name)
 
     # ---- pods (reference event_handlers.go:45-262) -------------------------
 
@@ -73,6 +85,7 @@ class EventHandlersMixin:
     def _add_task(self, ti: TaskInfo) -> None:
         """reference event_handlers.go:60-90"""
         job = self._get_or_create_job(ti)
+        self._stamp_dirty(ti.job, ti.node_name)
         if job is not None:
             job.add_task_info(ti)
         if ti.node_name:
@@ -91,6 +104,7 @@ class EventHandlersMixin:
 
     def _delete_task(self, ti: TaskInfo) -> None:
         """reference event_handlers.go deleteTask"""
+        self._stamp_dirty(ti.job, ti.node_name)
         job_err = node_err = None
         if ti.job:
             job = self.jobs.get(ti.job)
@@ -193,6 +207,7 @@ class EventHandlersMixin:
 
     def add_node(self, node: Node) -> None:
         with self.mutex:
+            self._stamp_dirty(node_name=node.name)
             if node.name in self.nodes:
                 self.nodes[node.name].set_node(node)
             else:
@@ -200,6 +215,7 @@ class EventHandlersMixin:
 
     def update_node(self, old_node: Node, new_node: Node) -> None:
         with self.mutex:
+            self._stamp_dirty(node_name=new_node.name)
             if new_node.name in self.nodes:
                 self.nodes[new_node.name].set_node(new_node)
             else:
@@ -207,6 +223,7 @@ class EventHandlersMixin:
 
     def delete_node(self, node: Node) -> None:
         with self.mutex:
+            self._stamp_dirty(node_name=node.name)
             self.nodes.pop(node.name, None)
 
     # ---- pod groups (reference event_handlers.go:370-659) ------------------
@@ -217,6 +234,7 @@ class EventHandlersMixin:
     def _set_pod_group(self, pg: PodGroup) -> None:
         """reference event_handlers.go:370-389 (incl. default-queue fallback)"""
         key = self._job_key(pg)
+        self._stamp_dirty(key)
         if key not in self.jobs:
             self.jobs[key] = JobInfo(key)
         self.jobs[key].set_pod_group(pg)
@@ -234,6 +252,7 @@ class EventHandlersMixin:
     def delete_pod_group(self, pg: PodGroup) -> None:
         with self.mutex:
             key = self._job_key(pg)
+            self._stamp_dirty(key)
             job = self.jobs.get(key)
             if job is not None:
                 job.unset_pod_group()
@@ -257,6 +276,7 @@ class EventHandlersMixin:
                 "not a gang source", pdb.namespace, pdb.name,
             )
             return False
+        self._stamp_dirty(job_key)
         job = self.jobs.get(job_key)
         if job is None:
             job = self.jobs[job_key] = JobInfo(job_key)

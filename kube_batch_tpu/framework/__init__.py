@@ -1,7 +1,7 @@
 """Framework kernel (mirrors reference pkg/scheduler/framework)."""
 
 from .arguments import Arguments
-from .event import Event, EventHandler
+from .event import Event, EventHandler, JobBatchEvent
 from .framework import close_session, open_session
 from .interface import Action, Plugin
 from .plugins import (

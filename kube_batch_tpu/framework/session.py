@@ -26,13 +26,14 @@ from ..api import (
     PodGroupCondition,
     PodGroupPhase,
     QueueInfo,
+    Resource,
     TaskInfo,
     TaskStatus,
     ValidateResult,
     allocated_status,
 )
 from ..conf import Tier
-from .event import Event, EventHandler
+from .event import Event, EventHandler, JobBatchEvent
 
 logger = logging.getLogger(__name__)
 
@@ -41,12 +42,15 @@ logger = logging.getLogger(__name__)
 last_apply_stats: dict = {}
 
 
-def _move_tasks_logged(job, tasks, status):
+def _move_tasks_logged(job, tasks, status, resreq_delta=None):
     """Bulk status move with the sequential loop's failure semantics: a
     group-level error degrades to per-task moves where each failure is
-    logged and skipped instead of aborting the job's whole group."""
+    logged and skipped instead of aborting the job's whole group.
+    ``resreq_delta``, when given, is the exact aggregate resreq sum of
+    ``tasks`` — the bulk path then updates ``job.allocated`` with ONE
+    Resource op instead of one per task."""
     try:
-        job.update_tasks_status(tasks, status)
+        job.update_tasks_status(tasks, status, resreq_delta=resreq_delta)
     except Exception:
         for task in tasks:
             try:
@@ -55,6 +59,28 @@ def _move_tasks_logged(job, tasks, status):
                 logger.exception(
                     "Failed to move Task %s to %s", task.uid, status
                 )
+
+
+def _fold_job_batches(jobs_map, tasks):
+    """Per-job :class:`JobBatchEvent` aggregates from a flat placed-task
+    list (the slow path when no precomputed grouping hint is usable).
+    Tasks whose job is unknown are logged and skipped."""
+    by_job: Dict[str, JobBatchEvent] = {}
+    for task in tasks:
+        batch = by_job.get(task.job)
+        if batch is None:
+            job = jobs_map.get(task.job)
+            if job is None:
+                logger.warning(
+                    "failed to find job %s for batch handlers", task.job
+                )
+                continue
+            batch = by_job[task.job] = JobBatchEvent(
+                job, [], Resource.empty()
+            )
+        batch.tasks.append(task)
+        batch.delta.add(task.resreq)
+    return list(by_job.values())
 
 
 class Session:
@@ -66,7 +92,12 @@ class Session:
         self.queues: Dict[str, QueueInfo] = {}
         self.backlog: List[JobInfo] = []
         self.tiers: List[Tier] = tiers or []
+        # Churn ledger from the cache snapshot (names touched since the
+        # previous snapshot) — observability for incremental tensorize.
+        self.dirty_jobs: frozenset = frozenset()
+        self.dirty_nodes: frozenset = frozenset()
 
+        self._total_allocatable: Optional[Resource] = None
         self.plugins: Dict[str, object] = {}
         self.event_handlers: List[EventHandler] = []
         self.job_order_fns: Dict[str, Callable] = {}
@@ -75,6 +106,7 @@ class Session:
         self.predicate_fns: Dict[str, Callable] = {}
         self.batch_predicate_fns: Dict[str, Callable] = {}
         self.batch_task_order_key_fns: Dict[str, Callable] = {}
+        self.batch_job_order_key_fns: Dict[str, Callable] = {}
         self.preemptable_fns: Dict[str, Callable] = {}
         self.reclaimable_fns: Dict[str, Callable] = {}
         self.overused_fns: Dict[str, Callable] = {}
@@ -98,6 +130,8 @@ class Session:
         self.jobs = snapshot.jobs
         self.nodes = snapshot.nodes
         self.queues = snapshot.queues
+        self.dirty_jobs = getattr(snapshot, "dirty_jobs", frozenset())
+        self.dirty_nodes = getattr(snapshot, "dirty_nodes", frozenset())
 
     def _validate_jobs(self) -> None:
         """Drop invalid jobs, persisting an Unschedulable condition
@@ -135,6 +169,7 @@ class Session:
         self.jobs = {}
         self.nodes = {}
         self.backlog = []
+        self._total_allocatable = None
         self.plugins = {}
         self.event_handlers = []
         self.job_order_fns = {}
@@ -143,6 +178,7 @@ class Session:
         self.predicate_fns = {}
         self.batch_predicate_fns = {}
         self.batch_task_order_key_fns = {}
+        self.batch_job_order_key_fns = {}
         self.preemptable_fns = {}
         self.reclaimable_fns = {}
         self.overused_fns = {}
@@ -186,6 +222,19 @@ class Session:
         from .statement import Statement
 
         return Statement(self)
+
+    def total_node_allocatable(self) -> Resource:
+        """Sum of ``allocatable`` over ALL session nodes (ready or not),
+        computed once per session and shared — drf and proportion each
+        paid their own O(nodes) accumulation pass at session open.
+        Returns a fresh clone per call; callers own the result."""
+        total = self._total_allocatable
+        if total is None:
+            total = Resource.empty()
+            for node in self.nodes.values():
+                total.add(node.allocatable)
+            self._total_allocatable = total
+        return total.clone()
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """Place onto releasing resources, session-only (session.go:194-234)."""
@@ -261,59 +310,118 @@ class Session:
             [(hostname, tasks, None) for hostname, tasks in staged.items()]
         )
 
-    def allocate_batch_grouped(self, node_groups) -> int:
+    def allocate_batch_grouped(self, node_groups, job_groups=None) -> int:
         """Apply a solved assignment set from PRE-GROUPED per-node lists
         — the zero-regroup fast path for allocate_tpu, whose fit guard
         already computed the per-node segmentation with numpy.
 
         ``node_groups`` is ``[(hostname, [tasks], delta)]`` where
         ``delta`` is the group's precomputed aggregate resreq (or None);
-        tasks carry no node_name yet. Semantics are
-        :meth:`allocate_batch`'s (volumes, status moves, node
-        accounting, plugin events, gang dispatch); only the staging
-        differs: per-node loops replace the 50k per-task dict passes.
-        Returns the number of tasks allocated."""
+        tasks carry no node_name yet. ``job_groups``, when given, is the
+        same assignment set PRE-GROUPED per job —
+        ``[(job_uid, [tasks], delta)]`` with ``delta`` the exact
+        aggregate resreq sum — so the apply tail skips the 50k per-task
+        regroup pass, the per-task ``job.allocated`` arithmetic, AND the
+        per-task plugin handler calls (aggregate JobBatchEvents go to
+        ``batch_allocate_func`` handlers instead). The hint is trusted
+        only while staging places every hinted task; any volume failure,
+        vanished node/job, or node-accounting fallback drops back to the
+        per-task fold so handler state can never drift from placements.
+
+        Semantics are :meth:`allocate_batch`'s (volumes, status moves,
+        node accounting, plugin events, gang dispatch); only the staging
+        differs. Returns the number of tasks allocated."""
         last_apply_stats.clear()
         t0 = _time.perf_counter()
+        hint_ok = job_groups is not None
+        staged_total = 0
         alloc_groups: List[tuple] = []  # (hostname, node, [tasks], delta)
         for hostname, tasks, delta in node_groups:
             node = self.nodes.get(hostname)
             if node is None:
                 logger.warning("failed to find node %s", hostname)
+                hint_ok = False
                 continue
-            ok = self.cache.allocate_volumes_batch(tasks, hostname)
-            for task in ok:
-                task.node_name = hostname
+            ok = self.cache.allocate_volumes_batch(
+                tasks, hostname, assign_node_name=True
+            )
+            staged_total += len(ok)
+            if len(ok) != len(tasks):
+                hint_ok = False
             alloc_groups.append((
                 hostname, node, ok, delta if len(ok) == len(tasks) else None
             ))
-        # Per-job ALLOCATED moves: group with one argsort-free pass
-        # (tasks of one job may span many nodes).
-        by_job: Dict[str, list] = {}
-        for _, _, tasks, _ in alloc_groups:
-            for task in tasks:
-                group = by_job.get(task.job)
-                if group is None:
-                    group = by_job[task.job] = []
-                group.append(task)
+        if hint_ok:
+            hint_ok = staged_total == sum(
+                len(group) for _, group, _ in job_groups
+            ) and all(self.jobs.get(uid) is not None
+                      for uid, _, _ in job_groups)
+        # Per-job ALLOCATED moves: from the hint when valid (one
+        # aggregate Resource op per job), else grouped with one
+        # argsort-free pass (tasks of one job may span many nodes).
         jobs_by_uid: Dict[str, JobInfo] = {}
-        for uid, group in by_job.items():
-            job = self.jobs.get(uid)
-            if job is None:
-                logger.warning("failed to find job %s", uid)
-                continue
-            jobs_by_uid[uid] = job
-            _move_tasks_logged(job, group, TaskStatus.ALLOCATED)
+        job_batches: Optional[List[JobBatchEvent]] = None
+        if hint_ok:
+            job_batches = []
+            for uid, group, delta in job_groups:
+                job = self.jobs[uid]
+                jobs_by_uid[uid] = job
+                # Whole-bucket fast path: the solver's tasks ARE the
+                # job's stored PENDING tasks (tensorize hands it the
+                # bucket values), so a group covering the whole bucket
+                # moves the bucket dict itself — no per-task
+                # verification or re-insert (spot-checked on the first
+                # task so a caller passing clones degrades safely).
+                bucket = job.task_status_index.get(TaskStatus.PENDING)
+                if (
+                    bucket is not None
+                    and len(bucket) == len(group)
+                    and bucket.get(group[0].uid) is group[0]
+                ):
+                    try:
+                        job.move_status_bucket(
+                            TaskStatus.PENDING,
+                            TaskStatus.ALLOCATED,
+                            resreq_delta=delta,
+                        )
+                    except Exception:
+                        logger.exception(
+                            "bucket move failed for job %s; retrying "
+                            "per task", uid,
+                        )
+                        _move_tasks_logged(
+                            job, group, TaskStatus.ALLOCATED,
+                            resreq_delta=delta,
+                        )
+                else:
+                    _move_tasks_logged(
+                        job, group, TaskStatus.ALLOCATED, resreq_delta=delta
+                    )
+                job_batches.append(JobBatchEvent(job, group, delta))
+        else:
+            by_job: Dict[str, list] = {}
+            for _, _, tasks, _ in alloc_groups:
+                for task in tasks:
+                    group = by_job.get(task.job)
+                    if group is None:
+                        group = by_job[task.job] = []
+                    group.append(task)
+            for uid, group in by_job.items():
+                job = self.jobs.get(uid)
+                if job is None:
+                    logger.warning("failed to find job %s", uid)
+                    continue
+                jobs_by_uid[uid] = job
+                _move_tasks_logged(job, group, TaskStatus.ALLOCATED)
         t1 = _time.perf_counter()
         last_apply_stats["stage_ms"] = (t1 - t0) * 1e3
 
-        events: List[Event] = []
+        placed_all: List[TaskInfo] = []
         for hostname, node, tasks, delta in alloc_groups:
             if delta is not None:
                 try:
                     node.add_tasks_prevalidated(tasks, delta)
-                    for task in tasks:
-                        events.append(Event(task))
+                    placed_all.extend(tasks)
                     continue
                 except Exception:
                     logger.exception(
@@ -321,18 +429,21 @@ class Session:
                         "falling back to guarded add", hostname,
                     )
             placed_list = node.add_tasks_with_fallback(tasks)
-            for task in placed_list:
-                events.append(Event(task))
+            if len(placed_list) != len(tasks):
+                job_batches = None  # hint no longer matches placements
+            placed_all.extend(placed_list)
         t2 = _time.perf_counter()
         last_apply_stats["account_ms"] = (t2 - t1) * 1e3
-        if not events:
+        if not placed_all:
             return 0
-        for eh in self.event_handlers:
-            if eh.batch_allocate_func is not None:
-                eh.batch_allocate_func(events)
-            elif eh.allocate_func is not None:
-                for ev in events:
-                    eh.allocate_func(ev)
+        # Observability for the bench (BENCH attribution): aggregate
+        # handler dispatch vs per-event, and whether the caller's
+        # precomputed job grouping survived staging intact.
+        last_apply_stats["handlers_batched"] = any(
+            eh.batch_allocate_func is not None for eh in self.event_handlers
+        )
+        last_apply_stats["job_groups_hint"] = job_batches is not None
+        self._fire_allocate_handlers(placed_all, job_batches)
         t3 = _time.perf_counter()
         last_apply_stats["handlers_ms"] = (t3 - t2) * 1e3
 
@@ -349,7 +460,32 @@ class Session:
         last_apply_stats["dispatch_ms"] = (
             _time.perf_counter() - t3
         ) * 1e3
-        return len(events)
+        return len(placed_all)
+
+    def _fire_allocate_handlers(self, placed_all, job_batches) -> None:
+        """Dispatch allocate events: aggregate JobBatchEvents to handlers
+        with a batch form (folding them from ``placed_all`` when no valid
+        pre-grouped hint survived staging), per-task Events to the rest."""
+        batch_fns = [
+            eh.batch_allocate_func
+            for eh in self.event_handlers
+            if eh.batch_allocate_func is not None
+        ]
+        legacy_fns = [
+            eh.allocate_func
+            for eh in self.event_handlers
+            if eh.batch_allocate_func is None and eh.allocate_func is not None
+        ]
+        if batch_fns:
+            if job_batches is None:
+                job_batches = _fold_job_batches(self.jobs, placed_all)
+            for fn in batch_fns:
+                fn(job_batches)
+        if legacy_fns:
+            events = [Event(task) for task in placed_all]
+            for fn in legacy_fns:
+                for ev in events:
+                    fn(ev)
 
     def dispatch_batch_grouped(self, groups) -> None:
         """Bind ready gangs from per-job groups: bulk BINDING moves per
@@ -357,20 +493,46 @@ class Session:
         bind_batch submission."""
         all_ready: List[TaskInfo] = []
         for job, tasks in groups:
-            ready: List[TaskInfo] = []
+            # bind_volumes is a no-op for ready-volume tasks (the
+            # overwhelming majority: claims-less pods) — scan first so
+            # the common all-ready gang skips the per-task try/except.
+            all_vols_ready = True
             for task in tasks:
-                # bind_volumes is a no-op for ready-volume tasks (the
-                # overwhelming majority: claims-less pods).
                 if not task.volume_ready:
-                    try:
-                        self.cache.bind_volumes(task)
-                    except Exception:
-                        logger.exception(
-                            "Failed to bind volumes of %s", task.uid
-                        )
-                        continue
-                ready.append(task)
-            _move_tasks_logged(job, ready, TaskStatus.BINDING)
+                    all_vols_ready = False
+                    break
+            if all_vols_ready:
+                ready = tasks
+            else:
+                ready = []
+                for task in tasks:
+                    if not task.volume_ready:
+                        try:
+                            self.cache.bind_volumes(task)
+                        except Exception:
+                            logger.exception(
+                                "Failed to bind volumes of %s", task.uid
+                            )
+                            continue
+                    ready.append(task)
+            if not ready:
+                continue
+            # Whole-bucket fast path (see allocate_batch_grouped): a
+            # ready gang's dispatch group IS its ALLOCATED bucket, so
+            # move the bucket dict instead of re-verifying per task.
+            # Allocated → Binding never flips allocated-status, so no
+            # Resource math either way.
+            bucket = job.task_status_index.get(TaskStatus.ALLOCATED)
+            if (
+                bucket is not None
+                and len(bucket) == len(ready)
+                and bucket.get(ready[0].uid) is ready[0]
+            ):
+                ready = job.move_status_bucket(
+                    TaskStatus.ALLOCATED, TaskStatus.BINDING
+                )
+            else:
+                _move_tasks_logged(job, ready, TaskStatus.BINDING)
             all_ready.extend(ready)
         # Latency is measured creation → dispatch (reference
         # session.go:316), so capture `now` here; but observe only the
@@ -436,6 +598,64 @@ class Session:
             if eh.deallocate_func is not None:
                 eh.deallocate_func(Event(reclaimee))
 
+    def evict_batch(
+        self, reclaimees: List[TaskInfo], reason: str
+    ) -> List[TaskInfo]:
+        """Batched :meth:`evict`: cache side effects and node accounting
+        keep their per-task semantics (each failure logged and skipped,
+        not fatal — the degraded form of evict()'s raise), while the job
+        status moves are bulked per job with one aggregate ``allocated``
+        update, and plugin deallocate handlers fire ONCE with per-job
+        :class:`JobBatchEvent` aggregates (per-event fallback for
+        handlers without a batch form). Returns the tasks actually
+        evicted (callers sum their resreqs to see what was freed)."""
+        by_job: Dict[str, list] = {}
+        for task in reclaimees:
+            group = by_job.get(task.job)
+            if group is None:
+                group = by_job[task.job] = []
+            group.append(task)
+        batches: List[JobBatchEvent] = []
+        for uid, group in by_job.items():
+            job = self.jobs.get(uid)
+            if job is None:
+                logger.warning("failed to find job %s when evicting", uid)
+                continue
+            evicted: List[TaskInfo] = []
+            delta = Resource.empty()
+            for task in group:
+                try:
+                    self.cache.evict(task, reason)
+                except Exception:
+                    logger.exception("Failed to evict Task %s", task.uid)
+                    continue
+                evicted.append(task)
+                delta.add(task.resreq)
+            if not evicted:
+                continue
+            _move_tasks_logged(
+                job, evicted, TaskStatus.RELEASING, resreq_delta=delta
+            )
+            for task in evicted:
+                node = self.nodes.get(task.node_name)
+                if node is not None:
+                    node.update_task(task)
+            batches.append(JobBatchEvent(job, evicted, delta))
+        if not batches:
+            return []
+        legacy_events: Optional[List[Event]] = None
+        for eh in self.event_handlers:
+            if eh.batch_deallocate_func is not None:
+                eh.batch_deallocate_func(batches)
+            elif eh.deallocate_func is not None:
+                if legacy_events is None:
+                    legacy_events = [
+                        Event(t) for b in batches for t in b.tasks
+                    ]
+                for ev in legacy_events:
+                    eh.deallocate_func(ev)
+        return [t for b in batches for t in b.tasks]
+
     def update_job_condition(self, job_info: JobInfo, cond: PodGroupCondition) -> None:
         """reference session.go:361-383"""
         job = self.jobs.get(job_info.uid)
@@ -487,10 +707,34 @@ class Session:
         task ordering in the snapshot path."""
         self.batch_task_order_key_fns[name] = fn
 
+    def add_batch_job_order_key_fn(self, name, fn):
+        """TPU-native extension: (jobs) -> ascending sort-key array
+        equivalent to the plugin's job_order_fn, enabling one numpy
+        lexsort over a queue's jobs in the snapshot path instead of
+        O(J log J) tiered comparison calls."""
+        self.batch_job_order_key_fns[name] = fn
+
     def add_preemptable_fn(self, name, fn):
         self.preemptable_fns[name] = fn
 
     def add_reclaimable_fn(self, name, fn):
+        """Register ``fn(reclaimer, reclaimees) -> victims``.
+
+        Contract the in-tree reclaim action's per-queue exhausted-node
+        memo depends on (actions/reclaim.py): within one cycle, a
+        registered fn's verdict about a given reclaimee must be
+        (a) CLAIMANT-INDEPENDENT — it may read the reclaimee's job/queue
+        state but not compare against the reclaimer (proportion, gang
+        and conformance all qualify; an upstream-style priority-vs-victim
+        comparison would not) — and (b) EVICTION-MONOTONE — evictions
+        performed during the cycle may only shrink (never grow) the
+        victim set it would return for the same node, except through a
+        successful claimant pipeline (which reclaim already handles by
+        invalidating other queues' memos). The reclaim action detects
+        fns outside the known-safe set and disables the memo for the
+        cycle, so registering a fn that violates this contract costs
+        throughput, not correctness — but keep the contract in mind
+        when writing one."""
         self.reclaimable_fns[name] = fn
 
     def add_overused_fn(self, name, fn):
@@ -711,6 +955,25 @@ class Session:
                 if kfn is None:
                     return None
                 keys.append(kfn(tasks))
+        return keys
+
+    def batch_job_order_keys(self, jobs):
+        """List of ascending key arrays (tier order) reproducing
+        job_order_fn, or None if an enabled job-order plugin has no
+        batch key form (callers then fall back to comparison sorting).
+        The (creation_timestamp, uid) tiebreak is the caller's, exactly
+        as in :meth:`job_order_fn`."""
+        keys: List = []
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not self._enabled(plugin.enabled_job_order):
+                    continue
+                if self.job_order_fns.get(plugin.name) is None:
+                    continue
+                kfn = self.batch_job_order_key_fns.get(plugin.name)
+                if kfn is None:
+                    return None
+                keys.append(kfn(jobs))
         return keys
 
     def batch_predicates(self) -> List:

@@ -47,8 +47,9 @@ class DrfPlugin(Plugin):
         attr.share = self._calculate_share(attr.allocated, self.total_resource)
 
     def on_session_open(self, ssn) -> None:
-        for node in ssn.nodes.values():
-            self.total_resource.add(node.allocatable)
+        # Shared per-session aggregate (one O(nodes) pass for all
+        # plugins, not one each).
+        self.total_resource = ssn.total_node_allocatable()
 
         for job in ssn.jobs.values():
             attr = _DrfAttr()
@@ -87,6 +88,16 @@ class DrfPlugin(Plugin):
 
         ssn.add_job_order_fn(self.name(), job_order_fn)
 
+        def batch_job_order_key(jobs):
+            import numpy as np
+
+            # Ascending key ≡ job_order_fn: lower share first.
+            return np.asarray(
+                [self.job_attrs[j.uid].share for j in jobs], np.float64
+            )
+
+        ssn.add_batch_job_order_key_fn(self.name(), batch_job_order_key)
+
         def on_allocate(event):
             attr = self.job_attrs[event.task.job]
             attr.allocated.add(event.task.resreq)
@@ -97,15 +108,23 @@ class DrfPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
-        def on_allocate_batch(events):
-            # Fold of on_allocate: one aggregate add + share update per
-            # job instead of per task (the apply-phase hot path).
-            touched: Dict[str, _DrfAttr] = {}
-            for ev in events:
-                attr = self.job_attrs[ev.task.job]
-                attr.allocated.add(ev.task.resreq)
-                touched[ev.task.job] = attr
-            for attr in touched.values():
+        def on_allocate_batch(batches):
+            # Aggregate fold of on_allocate: the share math is
+            # associative over a batch, so each JobBatchEvent costs ONE
+            # Resource add + one share update — ~#jobs work for a
+            # 50k-task apply instead of 50k per-task handler calls
+            # (drf.go:137-157's per-event form).
+            for b in batches:
+                attr = self.job_attrs[b.job.uid]
+                attr.allocated.add(b.delta)
+                self._update_share(attr)
+
+        def on_evict_batch(batches):
+            # Aggregate fold of on_deallocate (exact: deltas are sums
+            # of integral milli/byte quantities).
+            for b in batches:
+                attr = self.job_attrs[b.job.uid]
+                attr.allocated.sub(b.delta)
                 self._update_share(attr)
 
         ssn.add_event_handler(
@@ -113,6 +132,7 @@ class DrfPlugin(Plugin):
                 allocate_func=on_allocate,
                 deallocate_func=on_deallocate,
                 batch_allocate_func=on_allocate_batch,
+                batch_deallocate_func=on_evict_batch,
             )
         )
 

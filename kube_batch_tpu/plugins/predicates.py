@@ -47,9 +47,14 @@ POD_CACHE_ATTRS = ("_pred_cache",)
 
 
 def clear_pod_caches(pods) -> None:
-    """Drop this plugin's per-pod memos (see POD_CACHE_ATTRS)."""
+    """Drop the per-pod memos: this plugin's (POD_CACHE_ATTRS) plus the
+    pod_key memo (api.helpers), so a re-cold simulation pays every
+    first-touch cost a genuinely fresh pod would."""
+    from ..api.helpers import POD_KEY_CACHE_ATTR
+
+    attrs = POD_CACHE_ATTRS + (POD_KEY_CACHE_ATTR,)
     for pod in pods:
-        for attr in POD_CACHE_ATTRS:
+        for attr in attrs:
             if hasattr(pod, attr):
                 delattr(pod, attr)
 
@@ -143,6 +148,47 @@ def pod_tolerates_node_taints(task: TaskInfo, node: NodeInfo) -> None:
 def _check_pressure(node: NodeInfo, cond_type: str, reason: str) -> None:
     if _node_condition(node, cond_type) == "True":
         raise PredicateError(reason, f"node under {cond_type}")
+
+
+class _PredNodeCache:
+    """Cross-cycle node-column + template-group-row cache for the batch
+    predicate (stored on the scheduler cache as ``_pred_batch_cache``).
+
+    Same fingerprint contract as the tensorize cache
+    (solver/snapshot._TensorizeCache): the COW snapshot pool hands
+    consecutive sessions identical NodeInfo clone objects while nothing
+    changed, and every mutator bumps ``_ver``, so ``(identity, _ver)``
+    exactly identifies a node whose verdict columns are still valid.
+    Holding the node references pins their ids. ``sig_rows`` maps a pod
+    template signature to ``(rep_pod, has_selaff, row)`` — the [N] group
+    row is patched column-wise for dirty nodes and reused whole for the
+    rest."""
+
+    __slots__ = (
+        "flags", "node_objs", "node_vers", "node_ok", "has_taints",
+        "sig_rows",
+    )
+    # Retention bound for template rows whose signature did not appear
+    # in the current batch (kept warm so alternating bursts reuse them).
+    MAX_RETAINED_SIGS = 128
+
+    def __init__(self):
+        self.flags = None
+        self.node_objs = None
+        self.node_vers = None
+        self.node_ok = None
+        self.has_taints = None
+        self.sig_rows = {}
+
+
+class _SigRep:
+    """Minimal task stand-in for re-evaluating a cached signature row
+    (the predicate helpers only read ``task.pod``)."""
+
+    __slots__ = ("pod",)
+
+    def __init__(self, pod):
+        self.pod = pod
 
 
 class PredicatesPlugin(Plugin):
@@ -246,13 +292,12 @@ class PredicatesPlugin(Plugin):
             # watch object, so it is memoized on node.node keyed by the
             # pressure-flag combo — a watch update replaces the object
             # and invalidates naturally, exactly like the pod spec memo
-            # below. Only the pod-count cap stays live per cycle. This
-            # loop runs over EVERY node EVERY cycle (it was most of the
-            # 1%-delta tensorize floor at 5k nodes).
-            node_ok = np.ones(N, dtype=bool)
-            tainted: List[int] = []
+            # below. Only the pod-count cap stays live per cycle.
             flags = (mem_enable, disk_enable, pid_enable)
-            for j, node in enumerate(nodes):
+
+            def node_verdict(node):
+                """(schedulable, has_taints) for one node — exactly the
+                pre-incremental per-node loop body."""
                 knode = node.node
                 if knode is None:
                     # No backing object: evaluate directly (the checks
@@ -261,8 +306,7 @@ class PredicatesPlugin(Plugin):
                         check_node_condition(None, node)
                         check_node_unschedulable(None, node)
                     except PredicateError:
-                        node_ok[j] = False
-                        continue
+                        return False, False
                     has_taints = False
                 else:
                     # Unlike pod specs, node specs/conditions are
@@ -297,14 +341,60 @@ class PredicatesPlugin(Plugin):
                             bool(knode.spec.taints),
                         )
                     if not cached[1]:
-                        node_ok[j] = False
-                        continue
+                        return False, False
                     has_taints = cached[2]
                 if 0 < node.allocatable.max_task_num <= len(node.tasks):
-                    node_ok[j] = False
-                    continue
-                if has_taints:
-                    tainted.append(j)
+                    return False, has_taints
+                return True, has_taints
+
+            # Cross-cycle columns (see _PredNodeCache): dirty nodes are
+            # the fingerprint misses; only their verdicts re-run. A
+            # flags/node-set change rebuilds everything. This pass ran
+            # over EVERY node EVERY cycle before — it was most of the
+            # 1%-delta tensorize floor at 5k nodes.
+            pc = None
+            cache_host = getattr(ssn, "cache", None)
+            if cache_host is not None:
+                pc = getattr(cache_host, "_pred_batch_cache", None)
+                if pc is None:
+                    pc = _PredNodeCache()
+                    try:
+                        cache_host._pred_batch_cache = pc
+                    except Exception:
+                        pc = None
+            if (
+                pc is None
+                or pc.node_objs is None
+                or pc.flags != flags
+                or len(pc.node_objs) != N
+            ):
+                node_ok = np.empty(N, dtype=bool)
+                has_taints_col = np.empty(N, dtype=bool)
+                dirty = range(N)
+                prev_sig_rows = {}
+            else:
+                node_ok = pc.node_ok
+                has_taints_col = pc.has_taints
+                objs, vers = pc.node_objs, pc.node_vers
+                # C-level clean-path check (identity short-circuit);
+                # see solver/snapshot._refresh_node_arrays.
+                if objs == nodes and vers == [n._ver for n in nodes]:
+                    dirty = []
+                else:
+                    dirty = [
+                        j for j, n in enumerate(nodes)
+                        if objs[j] is not n or vers[j] != n._ver
+                    ]
+                prev_sig_rows = pc.sig_rows
+            for j in dirty:
+                node_ok[j], has_taints_col[j] = node_verdict(nodes[j])
+            if pc is not None and (dirty or pc.node_objs is None):
+                pc.flags = flags
+                pc.node_objs = list(nodes)
+                pc.node_vers = [n._ver for n in nodes]
+                pc.node_ok = node_ok
+                pc.has_taints = has_taints_col
+            tainted = np.nonzero(node_ok & has_taints_col)[0].tolist()
 
             def _terms_sig(terms):
                 # node_required is a list of terms (each a list of
@@ -336,6 +426,7 @@ class PredicatesPlugin(Plugin):
             sig_to_group: dict = {}
             task_group = np.empty(T, dtype=np.int32)
             reps: List[TaskInfo] = []
+            sig_list: List[tuple] = []  # signature per group, ∥ reps
             private: List[tuple] = []  # (i, task, has_ports, has_pod_aff)
             sig_get = sig_to_group.get
             for i, task in enumerate(tasks):
@@ -376,29 +467,84 @@ class PredicatesPlugin(Plugin):
                 if g is None:
                     g = sig_to_group[sig] = len(reps)
                     reps.append(task)
+                    sig_list.append(sig)
                 task_group[i] = g
                 if has_ports or has_pod_aff:
                     private.append((i, task, has_ports, has_pod_aff))
 
-            group_rows = np.ones((len(reps), N), dtype=bool)
-            for g, rep in enumerate(reps):
-                spec = rep.pod.spec
+            def build_sig_row(rep, has_selaff):
+                """Full [N] group row — the pre-incremental loops."""
+                row = np.ones(N, dtype=bool)
                 for j in tainted:
                     try:
                         pod_tolerates_node_taints(rep, nodes[j])
                     except PredicateError:
-                        group_rows[g, j] = False
-                aff = spec.affinity
-                if spec.node_selector or (
-                    aff is not None and aff.node_required
-                ):
+                        row[j] = False
+                if has_selaff:
                     for j in range(N):
-                        if not (node_ok[j] and group_rows[g, j]):
+                        if not (node_ok[j] and row[j]):
                             continue
                         try:
                             pod_match_node_selector(rep, nodes[j])
                         except PredicateError:
-                            group_rows[g, j] = False
+                            row[j] = False
+                return row
+
+            def patch_sig_row(row, rep, has_selaff):
+                """Re-verdict only the dirty columns of a cached row.
+                Column-for-column identical to build_sig_row: a not-ok
+                node's column resets to True (never evaluated), taints
+                then selector in order for the rest."""
+                for j in dirty:
+                    row[j] = True
+                    if not node_ok[j]:
+                        continue
+                    if has_taints_col[j]:
+                        try:
+                            pod_tolerates_node_taints(rep, nodes[j])
+                        except PredicateError:
+                            row[j] = False
+                            continue
+                    if has_selaff:
+                        try:
+                            pod_match_node_selector(rep, nodes[j])
+                        except PredicateError:
+                            row[j] = False
+                return row
+
+            # Template-group rows, kept alive across cycles per
+            # signature: a signature seen before costs O(dirty nodes);
+            # only new signatures pay the O(N) build. Rows retained for
+            # signatures absent from THIS batch (bounded) are patched
+            # too, so they stay valid for the next burst.
+            new_sig_rows: dict = {}
+            group_rows = np.empty((len(reps), N), dtype=bool)
+            for g, rep in enumerate(reps):
+                spec = rep.pod.spec
+                aff = spec.affinity
+                has_selaff = bool(spec.node_selector) or (
+                    aff is not None and bool(aff.node_required)
+                )
+                ent = prev_sig_rows.get(sig_list[g])
+                if ent is None:
+                    row = build_sig_row(rep, has_selaff)
+                else:
+                    row = patch_sig_row(ent[2], rep, has_selaff)
+                new_sig_rows[sig_list[g]] = (rep.pod, has_selaff, row)
+                group_rows[g] = row
+            if pc is not None:
+                for sig, ent in prev_sig_rows.items():
+                    if sig in new_sig_rows:
+                        continue
+                    if len(new_sig_rows) >= _PredNodeCache.MAX_RETAINED_SIGS:
+                        break
+                    rep_pod, has_selaff, row = ent
+                    new_sig_rows[sig] = (
+                        rep_pod,
+                        has_selaff,
+                        patch_sig_row(row, _SigRep(rep_pod), has_selaff),
+                    )
+                pc.sig_rows = new_sig_rows
 
             # Private rows: host ports and inter-pod (anti-)affinity —
             # only for the (rare) tasks collected above.
@@ -419,7 +565,9 @@ class PredicatesPlugin(Plugin):
                 rows[i] = row
 
             return BatchMask(
-                node_ok=node_ok,
+                # Copy: the cache patches its column in place next cycle
+                # and callers may hold the mask across cycles.
+                node_ok=node_ok.copy(),
                 task_group=task_group,
                 group_rows=group_rows,
                 rows=rows,

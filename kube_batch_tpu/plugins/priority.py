@@ -39,5 +39,13 @@ class PriorityPlugin(Plugin):
 
         ssn.add_job_order_fn(self.name(), job_order_fn)
 
+        def batch_job_order_key(jobs):
+            import numpy as np
+
+            # Ascending key ≡ job_order_fn: higher priority first.
+            return np.asarray([-j.priority for j in jobs], np.float64)
+
+        ssn.add_batch_job_order_key_fn(self.name(), batch_job_order_key)
+
 
 register_plugin_builder("priority", lambda args: PriorityPlugin(args))

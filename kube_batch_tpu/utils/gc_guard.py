@@ -16,7 +16,16 @@ so the guard is too: a lock serializes the depth/enable bookkeeping and
 the OUTERMOST enter records whether GC was on, so concurrent guards
 from different threads (e.g. scheduler cycle + side-effect worker)
 cannot strand GC disabled.
-"""
+
+The exit collection runs WHILE HOLDING the guard lock, decided by the
+last exiter (advisor r5: the earlier collect-after-release re-check
+only narrowed the race — a thread entering between the re-check and the
+collection's end still ate a stop-the-world pause inside its
+"GC-free" cycle). The trade: a concurrent guard entry now blocks for
+the duration of the exit collection — bounded, young-generation-only,
+and in the exiter's think time — which is strictly better than an
+unbounded pause landing mid-cycle. The lock is reentrant so a finalizer
+that somehow enters a guard during the collection cannot deadlock."""
 
 from __future__ import annotations
 
@@ -24,7 +33,7 @@ import gc
 import threading
 from contextlib import contextmanager
 
-_lock = threading.Lock()
+_lock = threading.RLock()
 _depth = 0
 _outer_was_enabled = False
 
@@ -45,20 +54,12 @@ def deferred_gc(collect_generation: int = 1):
     try:
         yield
     finally:
-        collect = False
         with _lock:
             _depth -= 1
             if _depth == 0 and _outer_was_enabled:
                 gc.enable()
-                collect = collect_generation >= 0
-        # Collect outside the lock: the exit collection can take tens
-        # of ms and must not block another thread's guard entry. But if
-        # another thread entered a guard in the window after we released
-        # the lock, collecting now would stop the world inside ITS
-        # supposedly GC-free cycle — re-check depth and let that
-        # thread's own exit do the collection instead.
-        if collect:
-            with _lock:
-                collect = _depth == 0
-            if collect:
-                gc.collect(collect_generation)
+                if collect_generation >= 0:
+                    # Under the lock, by the last exiter (see module
+                    # docstring): an entering thread waits here instead
+                    # of collecting mid-cycle later.
+                    gc.collect(collect_generation)
