@@ -32,7 +32,12 @@ import numpy as np
 sys.path.insert(0, ".")
 
 
-def _ensure_live_backend():
+# Backend-probe provenance for the output JSON ("device_provenance"):
+# attempts, outcomes, and whether this process was forced onto CPU.
+PROBE_INFO = {"forced_cpu": False, "attempts": []}
+
+
+def _ensure_live_backend(require_accelerator=False):
     """The tunneled TPU backend can be down/wedged; a bench that hangs or
     crashes records nothing. Probe device init in a SUBPROCESS with a hard
     timeout (an in-process probe would wedge this process too), retrying
@@ -40,24 +45,73 @@ def _ensure_live_backend():
     into a useless CPU number (round-1 lesson: BENCH_r01 recorded 0.1x on
     CPU). Only after every attempt fails re-exec the bench on CPU so a
     result is always produced (the JSON carries the actual platform in
-    its "device" field)."""
+    its "device" field) — unless ``require_accelerator``
+    (--require-accelerator / TPU_BATCH_BENCH_REQUIRE_DEVICE=1), which
+    fails LOUDLY instead: an on-device artifact was demanded, a silent
+    CPU number would be worse than no number."""
     from kube_batch_tpu.utils.backend import (
         force_cpu_devices,
+        last_probe_stats,
         probe_default_backend,
     )
 
     if os.environ.get("_KBT_BENCH_CPU") == "1":
+        if require_accelerator:
+            print(json.dumps({
+                "error": "accelerator required but this process was "
+                         "already forced onto the CPU fallback",
+            }))
+            sys.exit(3)
         # Fallback child: drop the wedged non-CPU factory before any
         # backend resolution (env alone does not stop it from dialing).
         force_cpu_devices(1)
+        # The parent's probe evidence rode through the re-exec — the
+        # CPU artifact must still say WHY it is a CPU artifact.
+        inherited = os.environ.get("_KBT_BENCH_PROBE", "")
+        if inherited:
+            try:
+                PROBE_INFO.update(json.loads(inherited))
+            except ValueError:
+                pass
+        PROBE_INFO["forced_cpu"] = True
         return
     # Cumulative probe budget ~4.5 min: a wedged tunnel hangs each probe
     # to its full timeout, and the large-config CPU fallback still needs
     # ~3 min of runway inside the driver's own deadline.
-    if probe_default_backend(
+    n = probe_default_backend(
         timeout=120, attempts=4, backoff=30, total_budget=270
-    ) > 0:
+    )
+    PROBE_INFO["attempts"] = list(last_probe_stats.get("attempts", []))
+    PROBE_INFO["probe_devices"] = n
+    platform = last_probe_stats.get("platform", "")
+    if require_accelerator and n > 0 and platform == "cpu":
+        # A live backend whose default platform is the host CPU is
+        # still not an accelerator — requiring a device means exactly
+        # that (the round-6 ask: no silent CPU artifacts).
+        print(
+            "bench: accelerator REQUIRED but the default jax backend "
+            "is cpu-only; refusing to record a CPU artifact",
+            file=sys.stderr,
+        )
+        print(json.dumps({
+            "error": "accelerator required but only the cpu backend "
+                     "is available",
+            "probe": PROBE_INFO,
+        }))
+        sys.exit(3)
+    if n > 0:
         return
+    if require_accelerator:
+        print(
+            "bench: accelerator REQUIRED but unreachable within the "
+            "probe budget; refusing the silent CPU fallback",
+            file=sys.stderr,
+        )
+        print(json.dumps({
+            "error": "accelerator required but unavailable",
+            "probe": PROBE_INFO,
+        }))
+        sys.exit(3)
     print(
         "bench: accelerator backend unavailable within the probe budget; "
         "falling back to CPU",
@@ -68,12 +122,17 @@ def _ensure_live_backend():
         "_KBT_BENCH_CPU": "1",
         "PALLAS_AXON_POOL_IPS": "",
         "JAX_PLATFORMS": "cpu",
+        # Carry the probe evidence into the child (see above).
+        "_KBT_BENCH_PROBE": json.dumps({
+            "attempts": PROBE_INFO["attempts"],
+            "probe_devices": PROBE_INFO.get("probe_devices", 0),
+        }),
     })
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 import kube_batch_tpu.actions  # noqa: F401
 import kube_batch_tpu.plugins  # noqa: F401
-from kube_batch_tpu.api import PodPhase, build_resource_list
+from kube_batch_tpu.api import PodPhase, TaskStatus, build_resource_list
 from kube_batch_tpu.cache import SchedulerCache
 from kube_batch_tpu.framework import close_session, get_action, open_session
 from kube_batch_tpu.solver import (
@@ -332,6 +391,10 @@ def bench_cycle(cfg, seed=0, cache=None):
             "action_ms": round((t_exec - t_open) * 1e3, 1),
             "close_ms": round((t_close - t_exec) * 1e3, 1),
             "cycle_ms": round((t_close - t_start) * 1e3, 1),
+            # close_session now runs under its own (nested) deferred_gc
+            # guard, so a generational collection can never land inside
+            # the close and jitter close_ms (r5: 2.1 -> 17.7 ms spikes).
+            "close_gc_deferred": True,
         }
         for k, v in _atpu.last_stats.items():
             out[k] = round(v, 1) if isinstance(v, float) else v
@@ -370,8 +433,67 @@ def bench_cycle(cfg, seed=0, cache=None):
     return {"cold": cold, "steady": steady, "idle": idle, "delta": delta}
 
 
+def bench_device_cache(cfg="small", seed=0):
+    """Device-resident snapshot pack across cold/steady/delta cycles:
+    the per-field reuse/patch/upload stats (solver/device_cache.py) for
+    the bench JSON. Always exercises the DEVICE pack path (tensorize
+    device=True) regardless of how allocate_tpu routes the solve, so
+    even a CPU-fallback artifact carries patched-row/bytes-shipped
+    evidence for the code in the tree; on a real accelerator run the
+    same stats additionally land in every cycle's ``device_*`` keys."""
+    from kube_batch_tpu.solver.device_cache import last_pack_stats
+
+    n_tasks, n_nodes, n_queues, n_groups = CONFIGS[cfg]
+    cache = build_cluster(n_tasks, n_nodes, n_queues, n_groups, seed)
+    tiers = make_tiers(*TIERS_ARGS)
+    out = {"config": cfg}
+
+    def pack_summary(t_ms):
+        keys = ("uploads", "patches", "reuses", "rows_patched",
+                "bytes_shipped", "bytes_total")
+        s = {k: last_pack_stats.get(k, 0) for k in keys}
+        s["tensorize_ms"] = round(t_ms, 1)
+        return s
+
+    def one(label, ssn):
+        t0 = time.perf_counter()
+        inputs, _ctx = tensorize(ssn)
+        out[label] = pack_summary((time.perf_counter() - t0) * 1e3)
+        return inputs
+
+    ssn = open_session(cache, tiers)
+    one("cold", ssn)      # every field uploads (cold cache)
+    one("steady", ssn)    # nothing changed: zero uploads, zero bytes
+    # Small churn: allocate ONE whole gang through the session (a full
+    # gang is JobReady, so its binds actually reach the cache mirror —
+    # partial allocations are session-only and would vanish at the next
+    # snapshot), packed onto a couple of nodes so the next pack patches
+    # a couple of node rows.
+    job = min(
+        (j for j in ssn.jobs.values()
+         if j.task_status_index.get(TaskStatus.PENDING)),
+        key=lambda j: (len(j.task_status_index[TaskStatus.PENDING]),
+                       j.uid),
+    )
+    gang = sorted(
+        job.task_status_index[TaskStatus.PENDING].values(),
+        key=lambda t: t.uid,
+    )
+    nodes = sorted(ssn.nodes)[: max(8, n_nodes // 10)]
+    ssn.allocate_batch([
+        (t, nodes[i % len(nodes)]) for i, t in enumerate(gang)
+    ])
+    cache.wait_for_side_effects()
+    cache.wait_for_bookkeeping()
+    close_session(ssn)
+    ssn = open_session(cache, tiers)
+    one("delta", ssn)     # dirty node rows patch; untouched fields reuse
+    close_session(ssn)
+    cache.shutdown()
+    return out
+
+
 def main():
-    _ensure_live_backend()
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small+medium only (CI-sized)")
@@ -379,7 +501,15 @@ def main():
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a JAX profiler trace of the headline "
                          "solve into DIR (view with TensorBoard)")
+    ap.add_argument(
+        "--require-accelerator", action="store_true",
+        default=os.environ.get("TPU_BATCH_BENCH_REQUIRE_DEVICE") == "1",
+        help="fail loudly (exit 3) when no accelerator backend is "
+             "reachable instead of silently benchmarking the CPU "
+             "fallback (also: TPU_BATCH_BENCH_REQUIRE_DEVICE=1)",
+    )
     args = ap.parse_args()
+    _ensure_live_backend(require_accelerator=args.require_accelerator)
 
     headline_cfg = args.config or ("medium" if args.quick else "large")
 
@@ -475,6 +605,22 @@ def main():
     except Exception as exc:  # pragma: no cover - defensive
         cycle = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # Device-resident snapshot pack stats (small config: the mechanics,
+    # not the scale — the headline cycles carry device_* keys whenever
+    # the jax path solved them). Guarded like the cycles.
+    try:
+        device_cache = bench_device_cache("small")
+    except Exception as exc:  # pragma: no cover - defensive
+        device_cache = {"error": f"{type(exc).__name__}: {exc}"}
+
+    dev0 = jax.devices()[0]
+    provenance = {
+        "platform": str(dev0.platform),
+        "device_kind": str(getattr(dev0, "device_kind", "")),
+        "num_devices": len(jax.devices()),
+        **PROBE_INFO,
+    }
+
     print(json.dumps({
         "metric": f"gang-cycle-solve-latency-{headline_cfg}"
                   f"-{CONFIGS[headline_cfg][0]}x{CONFIGS[headline_cfg][1]}",
@@ -489,7 +635,9 @@ def main():
         "greedy_small_ms": round(greedy_s * 1e3, 1),
         "greedy_extrapolated_ms": round(greedy_extrapolated_s * 1e3, 1),
         "device": str(jax.devices()[0].platform),
+        "device_provenance": provenance,
         "cycle": cycle,
+        "device_cache": device_cache,
         **extra,
     }))
 

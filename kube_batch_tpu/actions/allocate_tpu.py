@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 
 import numpy as np
@@ -85,12 +86,138 @@ def _use_native_solver() -> bool:
         return False
 
 
+# Single worker for the native in-flight solve: the ctypes call into
+# greedy.cpp releases the GIL, so the scheduler thread's host work
+# genuinely overlaps the C++ rounds. One scheduler loop → one slot.
+_native_pool = None
+_native_pool_lock = threading.Lock()
+
+
+def _native_executor():
+    global _native_pool
+    with _native_pool_lock:
+        if _native_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _native_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kbt-native-solve"
+            )
+        return _native_pool
+
+
+class AsyncSolveHandle:
+    """One in-flight batched solve with a SINGLE block point.
+
+    - jax backends: the jitted solve returns device futures immediately
+      (XLA async dispatch); :meth:`fetch` performs the one
+      device→host sync, on the assignment vector only.
+    - native backend: greedy.cpp runs on a worker thread (ctypes
+      releases the GIL for the foreign call), same fetch contract.
+
+    The session registers the handle at launch
+    (``Session.register_inflight_solve``) so ``Statement``
+    commit/discard and session close DRAIN it before touching the world
+    the solve snapshotted — commit/discard semantics are unchanged: no
+    transaction boundary can run concurrently with an outstanding
+    solve. ``fetch`` memoizes, so a guard-path drain never loses the
+    result the action still needs.
+    """
+
+    __slots__ = ("backend", "rounds", "_future", "_result", "_assigned")
+
+    def __init__(self, backend: str):
+        self.backend = backend
+        self.rounds = 0
+        self._future = None
+        self._result = None
+        self._assigned = None
+
+    @classmethod
+    def launch(cls, inputs, use_native: bool, max_rounds: int
+               ) -> "AsyncSolveHandle":
+        if use_native:
+            handle = cls("native")
+            from ..native import solve_native
+
+            handle._future = _native_executor().submit(
+                solve_native, inputs
+            )
+            return handle
+        import jax
+
+        handle = cls(f"jax-{jax.devices()[0].platform}")
+        # solve_sharded shards the node axis over all visible devices
+        # (the multi-chip scale path) and falls back to the cached
+        # single-device jit when only one device exists. The call
+        # returns the moment dispatch completes.
+        handle._result = solve_sharded(inputs, max_rounds=max_rounds)
+        return handle
+
+    def done(self) -> bool:
+        """Non-blocking completion poll (best-effort on jax backends
+        that do not expose buffer readiness)."""
+        if self._assigned is not None:
+            return True
+        if self._future is not None:
+            return self._future.done()
+        try:
+            return bool(self._result.assigned.is_ready())
+        except AttributeError:  # pragma: no cover - older jax
+            return True
+
+    def fetch(self) -> np.ndarray:
+        """The block point: the assignment vector as a host array
+        (memoized — a second fetch is free)."""
+        if self._assigned is not None:
+            return self._assigned
+        if self._future is not None:
+            assigned, _ = self._future.result()
+            self._assigned = np.asarray(assigned)
+            self.rounds = 1
+        else:
+            self._assigned = np.asarray(self._result.assigned)
+            self.rounds = int(self._result.rounds)
+        return self._assigned
+
+    def drain(self) -> None:
+        """Guard-path fetch: block until the solve is out of flight,
+        swallowing errors (the caller is tearing down or about to
+        mutate state; a failed solve must not mask that path)."""
+        try:
+            self.fetch()
+        except Exception:  # pragma: no cover - defensive
+            logger.exception("in-flight solve drain failed")
+
+
 class AllocateTpuAction(Action):
     def __init__(self, max_rounds: int = 256):
         self.max_rounds = max_rounds
 
     def name(self) -> str:
         return "allocate_tpu"
+
+    @staticmethod
+    def _releasing_candidates(ssn, ctx):
+        """Nodes that actually hold Releasing capacity (the only ones
+        the pipeline epilogue can use). In the common no-eviction cycle
+        this is empty and the O(leftovers x nodes) epilogue pass is
+        skipped. Candidates are narrowed with one numpy pass over the
+        snapshot's releasing matrix (releasing only accumulates task
+        resreqs, whose dims are always in the layout, so a non-empty
+        releasing always has a nonzero row) — the per-node Python walk
+        cost ~10 ms at 5k nodes on every cycle, releasing or not.
+        Assignment-independent, so it runs in the solve's overlap
+        window."""
+        if not ctx.has_releasing:
+            return []
+        rel_rows = np.asarray(
+            ctx.host_inputs.node_releasing[: len(ctx.nodes)]
+        )
+        return [
+            (j, ssn.nodes[ctx.nodes[j].name])
+            for j in np.nonzero(rel_rows.any(axis=1))[0].tolist()
+            if not ssn.nodes[ctx.nodes[j].name].releasing.is_empty()
+        ]
 
     def execute(self, ssn) -> None:
         # Clear BEFORE tensorize: if it raises, readers (bench cycle
@@ -116,25 +243,64 @@ class AllocateTpuAction(Action):
             return
 
         t0 = time.perf_counter()
-        if use_native:
-            from ..native import solve_native
+        # OVERLAPPED solve: launch is async (device rounds via XLA
+        # dispatch, native rounds on a GIL-releasing worker thread);
+        # the window below runs host work that does not depend on the
+        # assignment, and handle.fetch() is the single block point.
+        handle = AsyncSolveHandle.launch(
+            inputs, use_native, self.max_rounds
+        )
+        ssn.register_inflight_solve(handle)
+        t_launch = time.perf_counter()
+        last_stats["solve_launch_ms"] = (t_launch - t0) * 1e3
 
-            assigned, _ = solve_native(inputs)
-            rounds = 1
-            backend = "native"
-        else:
-            # solve_sharded shards the node axis over all visible devices
-            # (the multi-chip scale path) and falls back to the cached
-            # single-device jit when only one device exists.
-            result = solve_sharded(inputs, max_rounds=self.max_rounds)
-            assigned = np.asarray(result.assigned)
-            rounds = int(result.rounds)
-            import jax
+        # --- overlap window -------------------------------------------
+        # Device-cache pack forensics (dirty-ledger bookkeeping).
+        if not use_native:
+            from ..solver.device_cache import last_pack_stats
 
-            backend = f"jax-{jax.devices()[0].platform}"
+            for k, v in last_pack_stats.items():
+                if k == "full_reasons":
+                    if v:
+                        last_stats["device_full_reasons"] = dict(v)
+                else:
+                    last_stats[f"device_{k}"] = v
+        # Epilogue prep: the Releasing-capacity candidate scan reads
+        # only the snapshot, never the assignment.
+        releasing_nodes = self._releasing_candidates(ssn, ctx)
+        if not handle.done():
+            # The previous cycle's async bind/evict side effects drain
+            # on their worker threads; parking here (bounded) yields
+            # the GIL to them inside the solve's shadow instead of
+            # letting the backlog contend with the apply phase.
+            # Bool: did the previous cycle's bind queue fully drain
+            # inside the overlap window (vs the bounded wait timing
+            # out with backlog left).
+            last_stats["overlap_binds_drained"] = (
+                ssn.cache.wait_for_side_effects(timeout=0.02)
+            )
+        last_stats["overlap_ms"] = (
+            time.perf_counter() - t_launch
+        ) * 1e3
+
+        t_block = time.perf_counter()
+        assigned = handle.fetch()
+        ssn.register_inflight_solve(None)
+        rounds, backend = handle.rounds, handle.backend
         metrics.update_solver_cycle(rounds, backend)
+        last_stats["solve_block_ms"] = (
+            time.perf_counter() - t_block
+        ) * 1e3
         _record_phase("solve", (time.perf_counter() - t0) * 1e3)
         last_stats.update(backend=backend, rounds=rounds)
+        try:
+            from ..solver.kernels import jit_compilation_count
+
+            count = jit_compilation_count()
+            last_stats["jit_variants"] = count
+            metrics.update_solver_jit_cache(count)
+        except Exception:  # pragma: no cover - forensics only
+            logger.exception("jit cache census failed")
 
         t0 = time.perf_counter()
         # ctx.tasks is already in global priority-rank order. The
@@ -267,26 +433,8 @@ class AllocateTpuAction(Action):
         # Same gates as greedy: the task must pass predicates on the node
         # (kernel feas mask), its queue must not be overused
         # (allocate.go:94-95), and among eligible nodes the best-scored one
-        # wins, mirroring PrioritizeNodes → SelectBestNode.
-        #
-        # Only nodes that actually hold Releasing capacity can take a
-        # pipeline; in the common no-eviction cycle that set is empty and
-        # the whole O(leftovers x nodes) pass is skipped. Candidates are
-        # narrowed with one numpy pass over the snapshot's releasing
-        # matrix (releasing only accumulates task resreqs, whose dims are
-        # always in the layout, so a non-empty releasing always has a
-        # nonzero row) — the per-node Python walk cost ~10 ms at 5k
-        # nodes on every cycle, releasing or not.
-        releasing_nodes = []
-        if ctx.has_releasing:
-            rel_rows = np.asarray(
-                ctx.host_inputs.node_releasing[: len(ctx.nodes)]
-            )
-            releasing_nodes = [
-                (j, ssn.nodes[ctx.nodes[j].name])
-                for j in np.nonzero(rel_rows.any(axis=1))[0].tolist()
-                if not ssn.nodes[ctx.nodes[j].name].releasing.is_empty()
-            ]
+        # wins, mirroring PrioritizeNodes → SelectBestNode. The candidate
+        # set was computed in the solve's overlap window.
         leftovers = enumerate(ctx.tasks) if releasing_nodes else ()
         for i, task in leftovers:
             if int(assigned[i]) >= 0:

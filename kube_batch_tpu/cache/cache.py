@@ -848,6 +848,11 @@ class SchedulerCache(Cache, EventHandlersMixin):
         # no post-shutdown submissions.
         self._bookkeeping_executor.shutdown(wait=True)
         self._executor.shutdown(wait=True)
+        # Release the solver's device-resident snapshot buffers with the
+        # mirror they shadow (accelerator memory outlives nothing).
+        dc = getattr(self, "_device_snapshot_cache", None)
+        if dc is not None:
+            dc.drop()
 
     # String (reference cache.go String()) omitted; repr is enough.
     def __repr__(self) -> str:

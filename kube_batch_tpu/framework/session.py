@@ -96,6 +96,10 @@ class Session:
         # previous snapshot) — observability for incremental tensorize.
         self.dirty_jobs: frozenset = frozenset()
         self.dirty_nodes: frozenset = frozenset()
+        # The allocate_tpu AsyncSolveHandle currently in flight, if any
+        # (drain guard: Statement boundaries and session close block on
+        # it so no transaction or teardown races an outstanding solve).
+        self._inflight_solve = None
 
         self._total_allocatable: Optional[Resource] = None
         self.plugins: Dict[str, object] = {}
@@ -222,6 +226,24 @@ class Session:
         from .statement import Statement
 
         return Statement(self)
+
+    # ------------------------------------------- async-solve drain guard
+
+    def register_inflight_solve(self, handle) -> None:
+        """Track (or clear, with None) the action's in-flight async
+        solve. While registered, any Statement commit/discard and the
+        session close DRAIN the solve first — the overlapped cycle can
+        never leak an outstanding device computation across a
+        transaction boundary or session teardown."""
+        self._inflight_solve = handle
+
+    def drain_inflight_solve(self) -> None:
+        """Block until any registered async solve is out of flight
+        (no-op in the common already-fetched case)."""
+        handle = self._inflight_solve
+        if handle is not None:
+            handle.drain()
+            self._inflight_solve = None
 
     def total_node_allocatable(self) -> Resource:
         """Sum of ``allocatable`` over ALL session nodes (ready or not),

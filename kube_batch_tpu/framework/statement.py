@@ -104,7 +104,10 @@ class Statement:
     # -- transaction ends ----------------------------------------------------
 
     def discard(self) -> None:
-        """Undo in reverse (statement.go:198-209)."""
+        """Undo in reverse (statement.go:198-209). Drains any in-flight
+        async solve first: a rollback must not race an outstanding
+        device computation over the same session snapshot."""
+        self.ssn.drain_inflight_solve()
         for name, args in reversed(self.operations):
             if name == "evict":
                 self._unevict(args[0])
@@ -113,7 +116,9 @@ class Statement:
         self.operations = []
 
     def commit(self) -> None:
-        """Apply real cache evictions (statement.go:212-222)."""
+        """Apply real cache evictions (statement.go:212-222). Drains
+        any in-flight async solve first (see :meth:`discard`)."""
+        self.ssn.drain_inflight_solve()
         for name, args in self.operations:
             if name == "evict":
                 self._commit_evict(args[0], args[1])

@@ -207,6 +207,57 @@ solver_phase_latency = REGISTRY.register(
     ),
     ("phase",),
 )
+# Incremental-snapshot + device-residency counters (PR 1's dirty-name
+# ledger and PR 2's device cache): cache-hit regressions must show in
+# Prometheus, not just bench JSON.
+tensorize_cycles = REGISTRY.register(
+    Counter(
+        "tensorize_cycles_total",
+        "Tensorize node-array refreshes by path (incremental vs "
+        "full-rebuild reason)",
+    ),
+    ("path",),
+)
+tensorize_dirty_rows = REGISTRY.register(
+    Counter(
+        "tensorize_dirty_rows_total",
+        "Node rows patched by incremental tensorize",
+    )
+)
+device_cache_rows_patched = REGISTRY.register(
+    Counter(
+        "device_cache_rows_patched_total",
+        "Rows scatter-patched into resident device buffers",
+    )
+)
+device_cache_bytes_shipped = REGISTRY.register(
+    Counter(
+        "device_cache_bytes_shipped_total",
+        "Host->device bytes actually shipped by the snapshot pack",
+    )
+)
+device_cache_fields = REGISTRY.register(
+    Counter(
+        "device_cache_fields_total",
+        "Per-field pack outcomes (reuse / patch / upload)",
+    ),
+    ("outcome",),
+)
+device_cache_full_uploads = REGISTRY.register(
+    Counter(
+        "device_cache_full_uploads_total",
+        "Full-buffer uploads by reason "
+        "(cold/shape-change/bulk-dirty/small-buffer)",
+    ),
+    ("reason",),
+)
+solver_jit_compilations = REGISTRY.register(
+    Gauge(
+        "solver_jit_compilations",
+        "Distinct compiled variants across the solver and patch jits "
+        "(growth across steady cycles = a retrace regression)",
+    )
+)
 
 
 # Update helpers (reference metrics.go:122-170).
@@ -268,3 +319,39 @@ def update_solver_phase(phase: str, seconds: float) -> None:
     reference has no analog for: host tensorize vs device solve vs host
     apply)."""
     solver_phase_latency.observe(seconds, (phase,))
+
+
+def update_tensorize_cycle(
+    incremental: bool, dirty_rows: int, full_reason=None
+) -> None:
+    """Record one tensorize node-array refresh: which path ran and how
+    many rows it actually touched."""
+    path = "incremental" if incremental else f"full-{full_reason}"
+    tensorize_cycles.inc((path,))
+    # Only rows actually patched count; a full rebuild reports N "dirty"
+    # rows but ships through the rebuild path, not the patch path.
+    if incremental and dirty_rows:
+        tensorize_dirty_rows.inc(amount=float(dirty_rows))
+
+
+def update_device_cache(stats: dict) -> None:
+    """Fold one device-cache pack into the counters (``stats`` is
+    device_cache.last_pack_stats' schema)."""
+    if stats.get("rows_patched"):
+        device_cache_rows_patched.inc(amount=float(stats["rows_patched"]))
+    if stats.get("bytes_shipped"):
+        device_cache_bytes_shipped.inc(
+            amount=float(stats["bytes_shipped"])
+        )
+    for key, outcome in (
+        ("reuses", "reuse"), ("patches", "patch"), ("uploads", "upload")
+    ):
+        if stats.get(key):
+            device_cache_fields.inc((outcome,), amount=float(stats[key]))
+    for reason in stats.get("full_reasons", {}).values():
+        device_cache_full_uploads.inc((reason,))
+
+
+def update_solver_jit_cache(count: int) -> None:
+    """Gauge of compiled solver/patch variants (retrace forensics)."""
+    solver_jit_compilations.set(float(count))

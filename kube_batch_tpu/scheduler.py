@@ -71,7 +71,28 @@ class Scheduler:
             except Exception:
                 logger.exception("scheduling cycle failed")
             elapsed = time.perf_counter() - start
-            stop.wait(max(0.0, self.schedule_period - elapsed))
+            remaining = max(0.0, self.schedule_period - elapsed)
+            if remaining > 0:
+                # Think-time drain: absorb this cycle's async bind/evict
+                # backlog while the loop would otherwise sleep, so the
+                # next cycle's overlapped solve window starts from an
+                # empty side-effect queue (allocate_tpu parks on the
+                # same queue inside the solve's shadow). Sliced waits so
+                # the stop event stays responsive mid-drain.
+                deadline = time.perf_counter() + remaining
+                try:
+                    while not stop.is_set():
+                        left = deadline - time.perf_counter()
+                        if left <= 0:
+                            break
+                        if self.cache.wait_for_side_effects(
+                            timeout=min(0.2, left)
+                        ):
+                            break
+                except Exception:
+                    logger.exception("think-time side-effect drain failed")
+                remaining = max(0.0, deadline - time.perf_counter())
+            stop.wait(remaining)
 
     def run_once(self) -> None:
         """One scheduling cycle (reference scheduler.go:88-103). GC is

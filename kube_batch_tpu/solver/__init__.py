@@ -6,6 +6,7 @@ feasibility mask, cost matrix, round-based conflict-resolved assignment —
 jitted for TPU, with a sharded multi-chip variant.
 """
 
+from .device_cache import DeviceSnapshotCache, device_cache_of
 from .kernels import (
     PackedInputs,
     SolverInputs,
@@ -13,6 +14,7 @@ from .kernels import (
     build_feasibility,
     build_static_score,
     dynamic_scores,
+    jit_compilation_count,
     less_equal,
     make_inputs,
     segmented_cumsum,
@@ -41,8 +43,11 @@ __all__ = [
     "SolverResult",
     "BatchMask",
     "CombinedMask",
+    "DeviceSnapshotCache",
     "ResourceLayout",
     "SnapshotContext",
+    "device_cache_of",
+    "jit_compilation_count",
     "build_feasibility",
     "build_static_score",
     "combine_masks",

@@ -1005,3 +1005,27 @@ solve_staged_jit = jax.jit(
     solve_staged,
     static_argnames=("max_rounds", "tail_bucket", "allow_pallas"),
 )
+
+
+def jit_compilation_count() -> int:
+    """Distinct compiled variants across the module-level solve jits
+    plus the device-cache patch jits. A long-running scheduler's count
+    must go FLAT once the shape buckets are warm — growth across steady
+    cycles means a shape/dtype drift reintroduced per-cycle tracing
+    (pinned by tests/solver/test_retrace_guard.py; exported via
+    metrics.solver_jit_compilations)."""
+    from . import sharding, spmd
+    from .device_cache import patch_jit_cache_size
+
+    total = 0
+    fns = [solve_jit, solve_full_jit, solve_staged_jit]
+    for ref in spmd._jitted_steps + sharding._jitted_steps:
+        fn = ref()
+        if fn is not None:  # dead weakref = lru-evicted step
+            fns.append(fn)
+    for fn in fns:
+        try:
+            total += fn._cache_size()
+        except Exception:  # pragma: no cover - private-API drift
+            pass
+    return total + patch_jit_cache_size()

@@ -145,6 +145,11 @@ def pad_nodes(inputs, multiple: int):
     return inputs._replace(**repl)
 
 
+# Weakrefs to jitted GSPMD steps for the retrace census (see
+# spmd._jitted_steps — weak so eviction still frees the executable).
+_jitted_steps: list = []
+
+
 @functools.lru_cache(maxsize=32)
 def _sharded_step(mesh: Mesh, shardings, staged, max_rounds, tail_bucket):
     if staged is None:
@@ -158,10 +163,14 @@ def _sharded_step(mesh: Mesh, shardings, staged, max_rounds, tail_bucket):
     # operands whole onto every device (or fail to lower) — the fused
     # kernel is a single-device optimization; the sharded path keeps the
     # jnp chain, which partitions cleanly.
-    return jax.jit(
+    import weakref
+
+    step = jax.jit(
         lambda x: fn(x, max_rounds=max_rounds, allow_pallas=False),
         in_shardings=(shardings,),
     )
+    _jitted_steps.append(weakref.ref(step))
+    return step
 
 
 def _staged_for_shape(inputs, staged):

@@ -636,6 +636,14 @@ def _solve_spmd_local(inputs: SolverInputs, max_rounds: int,
     return SolverResult(assigned, idle, qalloc, rounds, stages)
 
 
+# Weakrefs to the jitted sharded steps, for the retrace census
+# (kernels.jit_compilation_count): the multi-chip path must show up in
+# the same compilation counters the retrace guard pins flat. Weak so
+# the census never pins an executable past its lru_cache eviction —
+# it counts LIVE compiled variants, exactly what the cache bounds.
+_jitted_steps: list = []
+
+
 @functools.lru_cache(maxsize=32)
 def _spmd_step(mesh: Mesh, staged, max_rounds, tail_bucket):
     """Jitted shard_map solve for a mesh (cached per config)."""
@@ -663,7 +671,11 @@ def _spmd_step(mesh: Mesh, staged, max_rounds, tail_bucket):
         )
         return fn(inputs)
 
-    return jax.jit(run)
+    import weakref
+
+    step = jax.jit(run)
+    _jitted_steps.append(weakref.ref(step))
+    return step
 
 
 def solve_spmd(

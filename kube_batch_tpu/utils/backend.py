@@ -19,6 +19,12 @@ import sys
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# Forensics of the most recent probe_default_backend() run: per-attempt
+# outcome + timing, and the resolved device count. Bench artifacts
+# embed this so a CPU number carries the evidence of WHY it is a CPU
+# number (round-6 standing ask: device provenance in the JSON).
+last_probe_stats: dict = {}
+
 
 def probe_default_backend(timeout=60, attempts=1, backoff=20,
                           total_budget=None):
@@ -36,6 +42,17 @@ def probe_default_backend(timeout=60, attempts=1, backoff=20,
     import time
 
     start = time.monotonic()
+    last_probe_stats.clear()
+    attempts_log: list = []
+    last_probe_stats.update(attempts=attempts_log, devices=0)
+
+    def _done(n):
+        last_probe_stats["devices"] = n
+        last_probe_stats["elapsed_s"] = round(
+            time.monotonic() - start, 2
+        )
+        return n
+
     for attempt in range(attempts):
         if total_budget is not None:
             # Budget-check BEFORE the backoff sleep (counting it), so the
@@ -44,23 +61,42 @@ def probe_default_backend(timeout=60, attempts=1, backoff=20,
             if attempt:
                 remaining -= backoff
             if remaining <= 5:
+                attempts_log.append({"outcome": "budget-exhausted"})
                 break
             timeout_eff = min(timeout, remaining)
         else:
             timeout_eff = timeout
         if attempt:
             time.sleep(backoff)
+        t0 = time.monotonic()
+        entry = {"timeout_s": round(timeout_eff, 1)}
+        attempts_log.append(entry)
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; print(len(jax.devices()))"],
+                 "import jax; d = jax.devices(); "
+                 "print(d[0].platform, len(d))"],
                 capture_output=True, timeout=timeout_eff, text=True,
             )
+            entry["elapsed_s"] = round(time.monotonic() - t0, 2)
             if probe.returncode == 0:
-                return int(probe.stdout.strip().splitlines()[-1])
-        except (subprocess.TimeoutExpired, ValueError, IndexError):
-            pass
-    return 0
+                platform, raw_n = (
+                    probe.stdout.strip().splitlines()[-1].split()
+                )
+                n = int(raw_n)
+                entry["outcome"] = "ok"
+                entry["devices"] = n
+                entry["platform"] = platform
+                last_probe_stats["platform"] = platform
+                return _done(n)
+            entry["outcome"] = f"rc={probe.returncode}"
+        except subprocess.TimeoutExpired:
+            entry["elapsed_s"] = round(time.monotonic() - t0, 2)
+            entry["outcome"] = "timeout"
+        except (ValueError, IndexError):
+            entry["elapsed_s"] = round(time.monotonic() - t0, 2)
+            entry["outcome"] = "unparseable"
+    return _done(0)
 
 
 def set_host_device_count(n, env=None):

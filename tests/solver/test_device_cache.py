@@ -1,0 +1,229 @@
+"""Device-resident snapshot parity: the patched resident buffers must
+be BIT-IDENTICAL to a fresh cold pack of the same snapshot, across
+randomized churn and through every fallback path (bucket growth, layout
+change, bulk dirtiness) — and the solver must produce identical results
+on either.
+
+The contract under test (solver/device_cache.py): a field either
+reuses its resident buffer (host arrays identical), scatter-patches the
+dirty rows (donated in-place update), or re-uploads whole; whichever
+path ran, ``np.asarray(device buffer) == host array`` exactly.
+"""
+
+import numpy as np
+import pytest
+
+import kube_batch_tpu.actions  # noqa: F401 (registers actions)
+import kube_batch_tpu.plugins  # noqa: F401 (registers plugins)
+from kube_batch_tpu.api import PodPhase, TaskStatus, build_resource_list
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.solver import PackedInputs, solve_jit, tensorize
+from kube_batch_tpu.solver.device_cache import last_pack_stats
+from kube_batch_tpu.utils.test_utils import build_pod, build_pod_group
+
+from tests.actions.test_actions import DEFAULT_TIERS_ARGS, make_tiers
+from tests.unit.test_cycle_pipeline import build_cluster, session_pairs
+
+
+def drop_device_cache(cache):
+    if hasattr(cache, "_device_snapshot_cache"):
+        delattr(cache, "_device_snapshot_cache")
+
+
+def snapshot_fields(inputs):
+    """Host copies of every PackedInputs buffer, taken IMMEDIATELY (a
+    later patch donates and deletes resident buffers)."""
+    return {f: np.asarray(getattr(inputs, f)) for f in inputs._fields}
+
+
+def pack_twice_and_compare(ssn):
+    """Pack via the resident cache, then via a fresh cold cache, and
+    require bit-identical buffers. Returns the cached-path pack stats.
+    The fresh pack REPLACES the device cache, so the next cycle patches
+    against known-good state (continuity stays exercised)."""
+    inputs_cached, ctx = tensorize(ssn)
+    if inputs_cached is None:
+        drop_device_cache(ssn.cache)
+        inputs_fresh, _ = tensorize(ssn)
+        assert inputs_fresh is None
+        return None
+    cached = snapshot_fields(inputs_cached)
+    stats = dict(last_pack_stats)
+    drop_device_cache(ssn.cache)
+    inputs_fresh, _ = tensorize(ssn)
+    assert dict(last_pack_stats)["uploads"] == len(PackedInputs._fields)
+    fresh = snapshot_fields(inputs_fresh)
+    for name in PackedInputs._fields:
+        np.testing.assert_array_equal(
+            cached[name], fresh[name],
+            err_msg=f"device-patched vs fresh pack mismatch in {name}",
+        )
+    return stats
+
+
+class TestDeviceCacheParity:
+    def test_randomized_churn_parity(self):
+        rng = np.random.RandomState(17)
+        c = build_cluster(seed=17, groups=8, per_group=6, nodes=8)
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        saw_patch = saw_reuse = False
+        extra = 0
+        for cycle in range(8):
+            ssn = open_session(c, tiers)
+            stats = pack_twice_and_compare(ssn)
+            if stats is not None:
+                saw_patch = saw_patch or stats["patches"] > 0
+                saw_reuse = saw_reuse or stats["reuses"] > 0
+            # Churn: place a random subset, plus new arrivals every
+            # other cycle (same protocol as the tensorize parity test).
+            pairs = session_pairs(ssn)
+            if pairs:
+                take = rng.randint(1, min(6, len(pairs)) + 1)
+                idx = rng.choice(len(pairs), size=take, replace=False)
+                ssn.allocate_batch([pairs[i] for i in sorted(idx)])
+            assert c.wait_for_side_effects()
+            assert c.wait_for_bookkeeping()
+            close_session(ssn)
+            if cycle % 2 == 0:
+                g = f"pgx{extra}"
+                extra += 1
+                c.add_pod_group(build_pod_group(
+                    g, namespace="ns", min_member=1, queue="q0"
+                ))
+                for i in range(int(rng.randint(1, 4))):
+                    c.add_pod(build_pod(
+                        "ns", f"{g}-p{i}", "", PodPhase.PENDING,
+                        build_resource_list(
+                            cpu=f"{int(rng.choice([250, 500]))}m",
+                            memory="256Mi",
+                        ),
+                        group_name=g,
+                    ))
+        # The loop must have exercised the interesting paths, not just
+        # cold uploads.
+        assert saw_patch and saw_reuse
+        c.shutdown()
+
+    def test_solver_results_bit_exact_on_patched_inputs(self):
+        """Solve on device-patched buffers == solve on a fresh pack."""
+        c = build_cluster(seed=23)
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        ssn = open_session(c, tiers)
+        tensorize(ssn)  # cold pack -> resident buffers
+        # Churn a couple of placements so the next pack patches.
+        pairs = session_pairs(ssn)
+        ssn.allocate_batch(pairs[:3])
+        assert c.wait_for_side_effects()
+        assert c.wait_for_bookkeeping()
+        close_session(ssn)
+
+        ssn = open_session(c, tiers)
+        inputs_cached, _ = tensorize(ssn)
+        r_cached = solve_jit(inputs_cached)
+        a_cached = np.asarray(r_cached.assigned)
+        drop_device_cache(c)
+        inputs_fresh, _ = tensorize(ssn)
+        r_fresh = solve_jit(inputs_fresh)
+        np.testing.assert_array_equal(
+            a_cached, np.asarray(r_fresh.assigned)
+        )
+        close_session(ssn)
+        c.shutdown()
+
+    def test_steady_cycle_zero_uploads(self):
+        """An unchanged snapshot reuses every resident buffer: zero
+        host->device bytes shipped."""
+        c = build_cluster(seed=29)
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        ssn = open_session(c, tiers)
+        tensorize(ssn)  # cold
+        inputs, _ = tensorize(ssn)  # identical snapshot
+        assert inputs is not None
+        stats = dict(last_pack_stats)
+        assert stats["uploads"] == 0
+        assert stats["patches"] == 0
+        assert stats["bytes_shipped"] == 0
+        assert stats["reuses"] == len(PackedInputs._fields)
+        close_session(ssn)
+        c.shutdown()
+
+    def test_bucket_growth_falls_back_to_full_upload(self):
+        """Crossing a task-shape bucket changes buffer shapes; the task
+        fields must re-upload (reason: shape-change) and stay exact."""
+        c = build_cluster(seed=31, groups=6, per_group=8)  # 48 tasks
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        ssn = open_session(c, tiers)
+        inputs, _ = tensorize(ssn)
+        assert inputs.task_f32.shape[1] == 256  # bucket floor
+        close_session(ssn)
+        # Grow past the 256 bucket.
+        c.add_pod_group(build_pod_group(
+            "pgrow", namespace="ns", min_member=1, queue="q0"
+        ))
+        for i in range(240):
+            c.add_pod(build_pod(
+                "ns", f"pgrow-p{i}", "", PodPhase.PENDING,
+                build_resource_list(cpu="250m", memory="256Mi"),
+                group_name="pgrow",
+            ))
+        ssn = open_session(c, tiers)
+        stats = pack_twice_and_compare(ssn)
+        assert stats["full_reasons"].get("task_f32") == "shape-change"
+        assert stats["full_reasons"].get("task_i32") == "shape-change"
+        close_session(ssn)
+        c.shutdown()
+
+    def test_layout_change_falls_back_to_full_upload(self):
+        """A new scalar resource grows the resource dim R; every
+        R-bearing buffer re-uploads and stays exact."""
+        c = build_cluster(seed=37)
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        ssn = open_session(c, tiers)
+        tensorize(ssn)
+        close_session(ssn)
+        c.add_pod_group(build_pod_group(
+            "pgpu", namespace="ns", min_member=1, queue="q0"
+        ))
+        c.add_pod(build_pod(
+            "ns", "pgpu-p0", "", PodPhase.PENDING,
+            build_resource_list(cpu="500m", memory="256Mi",
+                                **{"nvidia.com/gpu": 1}),
+            group_name="pgpu",
+        ))
+        ssn = open_session(c, tiers)
+        stats = pack_twice_and_compare(ssn)
+        for f in ("task_f32", "node_f32", "queue_f32", "misc"):
+            assert stats["full_reasons"].get(f) == "shape-change", f
+        close_session(ssn)
+        c.shutdown()
+
+    def test_pack_ownership_is_cache_scoped(self):
+        """A later patch donates the prior cycle's buffer: holding
+        PackedInputs across packs on the same scheduler cache is a
+        documented ownership violation, pinned here so the rule never
+        silently changes."""
+        c = build_cluster(seed=41)
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        ssn = open_session(c, tiers)
+        inputs0, _ = tensorize(ssn)
+        held = {f: getattr(inputs0, f) for f in inputs0._fields}
+        pairs = session_pairs(ssn)
+        ssn.allocate_batch(pairs[:2])
+        assert c.wait_for_side_effects()
+        assert c.wait_for_bookkeeping()
+        close_session(ssn)
+        ssn = open_session(c, tiers)
+        inputs1, _ = tensorize(ssn)
+        stats = dict(last_pack_stats)
+        close_session(ssn)
+        patched = [
+            f for f, o in stats["field_outcomes"].items() if o == "patch"
+        ]
+        if not patched:
+            pytest.skip("churn produced no patch on this backend")
+        # The donated buffers are deleted; the fresh ones are intact.
+        for f in patched:
+            with pytest.raises(RuntimeError):
+                np.asarray(held[f]) + 0
+            assert np.asarray(getattr(inputs1, f)).shape == held[f].shape
+        c.shutdown()

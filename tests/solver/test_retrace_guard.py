@@ -1,0 +1,70 @@
+"""Retrace-regression guard: steady/delta cycles must not mint new jit
+compilations.
+
+The whole device-resident design leans on shape stability — task/node/
+group/pair axes are bucketed (snapshot._task_bucket/_pow2/128s) and the
+patch row axis is power-of-two bucketed — so a long-running scheduler
+compiles a bounded set of programs and then runs trace-free. A shape or
+dtype drift anywhere in the pack (a field stacked in a different order,
+an un-bucketed axis, a float64 leak) would silently reintroduce
+per-cycle tracing: ~seconds of XLA compile inside a ~10 ms cycle
+budget. This test pins the invariant with the compilation-cache
+counters (``jit_compilation_count``: solve jits + device-cache patch
+jits) across churning cycles that stay inside their buckets.
+"""
+
+import numpy as np
+
+import kube_batch_tpu.actions  # noqa: F401 (registers actions)
+import kube_batch_tpu.plugins  # noqa: F401 (registers plugins)
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.solver import jit_compilation_count, solve_jit, tensorize
+
+from tests.actions.test_actions import DEFAULT_TIERS_ARGS, make_tiers
+from tests.unit.test_cycle_pipeline import build_cluster
+
+
+WARM_CYCLES = 3   # cold pack + first patch buckets + solve compile
+GUARD_CYCLES = 6  # steady/delta cycles that must stay trace-free
+
+
+def one_cycle(cache, tiers, churn):
+    """One tensorize → solve → apply-some cycle; churn keeps every axis
+    inside its shape bucket (fixed task count per step, fixed node
+    fan-out) so no re-jit is legitimate."""
+    ssn = open_session(cache, tiers)
+    inputs, ctx = tensorize(ssn)
+    placed = 0
+    if inputs is not None:
+        result = solve_jit(inputs)
+        assigned = np.asarray(result.assigned)
+        # Apply a FIXED-SIZE slice of the assignment through the
+        # session so the mirror churns by the same amount every cycle.
+        pairs = []
+        for i in np.nonzero(assigned[: len(ctx.tasks)] >= 0)[0][:churn]:
+            pairs.append((ctx.tasks[i], ctx.nodes[assigned[i]].name))
+        if pairs:
+            placed = ssn.allocate_batch(pairs)
+    assert cache.wait_for_side_effects()
+    assert cache.wait_for_bookkeeping()
+    close_session(ssn)
+    return placed
+
+
+def test_zero_new_compilations_across_steady_delta_cycles():
+    # 240 pending tasks: stays inside the 256-row task bucket for the
+    # whole run (churn of 2/cycle drains 18 by the end).
+    c = build_cluster(seed=43, groups=6, per_group=40, nodes=8)
+    tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+    for _ in range(WARM_CYCLES):
+        one_cycle(c, tiers, churn=2)
+    warm = jit_compilation_count()
+    assert warm > 0  # the solve jit at least compiled once
+    for cycle in range(GUARD_CYCLES):
+        one_cycle(c, tiers, churn=2)
+        now = jit_compilation_count()
+        assert now == warm, (
+            f"cycle {cycle} minted {now - warm} new jit compilation(s) "
+            "— a shape/dtype drift reintroduced per-cycle tracing"
+        )
+    c.shutdown()
