@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench verify ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke verify ci image clean
 
 all: native
 
@@ -42,6 +42,13 @@ perf-quick:
 bench:
 	$(PY) bench.py
 
+# Sparse-path smoke: small shapes through the full production cycle
+# with the top-K candidate solver FORCED (KBT_SOLVER_TOPK=8), asserting
+# via the new sparse stats that the path actually engaged — exit 4 on a
+# silent dense fallback. Fast (~seconds); runs in CI alongside pytest.
+bench-smoke:
+	env $(CPU_ENV) _KBT_BENCH_CPU=1 KBT_SOLVER_TOPK=8 $(PY) bench.py --smoke
+
 # Static checks (reference verify: gofmt/goimports/golint,
 # Makefile:13-17): byte-compile + the AST lint (unused/duplicate
 # imports, star imports, syntax).
@@ -55,7 +62,7 @@ verify:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify native test
+ci: verify native test bench-smoke
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

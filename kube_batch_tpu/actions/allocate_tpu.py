@@ -123,11 +123,20 @@ class AsyncSolveHandle:
     result the action still needs.
     """
 
-    __slots__ = ("backend", "rounds", "_future", "_result", "_assigned")
+    __slots__ = (
+        "backend", "rounds", "refills", "stages", "native_stats",
+        "_future", "_result", "_assigned",
+    )
 
     def __init__(self, backend: str):
         self.backend = backend
         self.rounds = 0
+        # Sparse-solve forensics, populated by fetch(): jax path reports
+        # SolverResult.refills/stages (None on a dense solve), native
+        # path snapshots native.greedy.last_solve_stats.
+        self.refills = None
+        self.stages = None
+        self.native_stats = None
         self._future = None
         self._result = None
         self._assigned = None
@@ -174,9 +183,16 @@ class AsyncSolveHandle:
             assigned, _ = self._future.result()
             self._assigned = np.asarray(assigned)
             self.rounds = 1
+            from ..native.greedy import last_solve_stats
+
+            self.native_stats = dict(last_solve_stats)
         else:
             self._assigned = np.asarray(self._result.assigned)
             self.rounds = int(self._result.rounds)
+            if self._result.refills is not None:
+                self.refills = int(self._result.refills)
+            if self._result.stages is not None:
+                self.stages = int(self._result.stages)
         return self._assigned
 
     def drain(self) -> None:
@@ -293,6 +309,44 @@ class AllocateTpuAction(Action):
         ) * 1e3
         _record_phase("solve", (time.perf_counter() - t0) * 1e3)
         last_stats.update(backend=backend, rounds=rounds)
+
+        # Sparse-solve attribution: whether this cycle's solve ran the
+        # candidate-sparsified path, how much refill work it needed, and
+        # why it fell back to dense when it did (bench + Prometheus).
+        tsparse = last_stats.get("tensorize_sparse") or {}
+        engaged = False
+        refill_rounds = 0
+        fallback_reason = None
+        if backend == "native":
+            ns = handle.native_stats or {}
+            engaged = bool(ns.get("sparse"))
+            refill_rounds = int(ns.get("refill_rounds", 0))
+            if engaged:
+                last_stats["sparse_fallback_scans"] = ns.get(
+                    "fallback_scans", 0
+                )
+                last_stats["sparse_widened"] = ns.get("widened", 0)
+        else:
+            engaged = handle.refills is not None
+            if engaged:
+                # Refill ROUNDS = compacted dense stages that drained
+                # the refill-flagged tasks; the task count rides along.
+                refill_rounds = int(handle.stages or 0)
+                last_stats["sparse_refill_tasks"] = handle.refills
+            elif tsparse.get("enabled"):
+                # tensorize built slabs but the solve ignored them: the
+                # sharded multi-chip path keeps the dense rounds.
+                fallback_reason = "sharded-mesh"
+        if not engaged and fallback_reason is None:
+            fallback_reason = tsparse.get("reason")
+        last_stats["sparse_engaged"] = engaged
+        if engaged:
+            last_stats["sparse_k"] = tsparse.get("k")
+            last_stats["sparse_refill_rounds"] = refill_rounds
+        elif fallback_reason:
+            last_stats["sparse_fallback_reason"] = fallback_reason
+        metrics.update_solver_sparse(engaged, refill_rounds,
+                                     fallback_reason)
         try:
             from ..solver.kernels import jit_compilation_count
 

@@ -258,6 +258,37 @@ solver_jit_compilations = REGISTRY.register(
         "(growth across steady cycles = a retrace regression)",
     )
 )
+# Candidate-sparsified solve counters (solver/topk.py + the sparse
+# kernels/native loop): engagement, refill work, and dense fallbacks
+# must be observable in Prometheus, not just bench JSON.
+solver_sparse_solves = REGISTRY.register(
+    Counter(
+        "solver_sparse_solves_total",
+        "Cycles solved through the top-K candidate-sparsified path",
+    )
+)
+solver_sparse_refill_rounds = REGISTRY.register(
+    Counter(
+        "solver_sparse_refill_rounds_total",
+        "Candidate refill rounds (slab exhaustion -> widened/compacted "
+        "dense stages) across sparse solves",
+    )
+)
+solver_sparse_dense_fallbacks = REGISTRY.register(
+    Counter(
+        "solver_sparse_dense_fallbacks_total",
+        "Solves that fell back to the dense path by reason "
+        "(class-budget/sharded-mesh/env-disabled)",
+    ),
+    ("reason",),
+)
+solver_sparse_slab_bytes = REGISTRY.register(
+    Counter(
+        "solver_sparse_slab_bytes_shipped_total",
+        "Host->device bytes shipped for candidate-slab fields "
+        "(cand_idx/cand_static/cand_info) by the snapshot pack",
+    )
+)
 
 
 # Update helpers (reference metrics.go:122-170).
@@ -350,6 +381,30 @@ def update_device_cache(stats: dict) -> None:
             device_cache_fields.inc((outcome,), amount=float(stats[key]))
     for reason in stats.get("full_reasons", {}).values():
         device_cache_full_uploads.inc((reason,))
+    if stats.get("slab_bytes_shipped"):
+        solver_sparse_slab_bytes.inc(
+            amount=float(stats["slab_bytes_shipped"])
+        )
+
+
+# Dense-fallback reasons that represent a genuine fallback (the sparse
+# path was wanted but could not run), as opposed to the size policy
+# simply preferring dense on a small problem.
+_SPARSE_FALLBACK_REASONS = frozenset(
+    ("class-budget", "sharded-mesh", "env-disabled")
+)
+
+
+def update_solver_sparse(
+    engaged: bool, refill_rounds: int, fallback_reason=None
+) -> None:
+    """Record one allocate_tpu solve's sparse-path outcome."""
+    if engaged:
+        solver_sparse_solves.inc()
+        if refill_rounds:
+            solver_sparse_refill_rounds.inc(amount=float(refill_rounds))
+    elif fallback_reason in _SPARSE_FALLBACK_REASONS:
+        solver_sparse_dense_fallbacks.inc((fallback_reason,))
 
 
 def update_solver_jit_cache(count: int) -> None:

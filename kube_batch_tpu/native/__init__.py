@@ -10,6 +10,7 @@ on demand with the system toolchain; callers must handle
 from .greedy import (
     NativeUnavailable,
     greedy_allocate,
+    last_solve_stats,
     native_available,
     solve_native,
 )
@@ -17,6 +18,7 @@ from .greedy import (
 __all__ = [
     "NativeUnavailable",
     "greedy_allocate",
+    "last_solve_stats",
     "native_available",
     "solve_native",
 ]

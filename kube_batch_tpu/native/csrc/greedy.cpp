@@ -464,4 +464,340 @@ int64_t greedy_allocate_masked(
   return placed;
 }
 
+// Candidate-sparsified greedy allocate: the CPU half of the top-K
+// sparse solve (solver/topk.py selects; this consumes).
+//
+// Same sequential semantics as greedy_allocate_masked, but each
+// candidate CLASS (tasks sharing predicate group + req/fit + private
+// rows; task_cand maps task -> class) keeps a lazy max-heap over only
+// its K candidate nodes instead of all N. The win is twofold: heap
+// state shrinks from O(classes * N) to O(classes * K), and — the
+// masked loop's dominant cost at 50k x 5k — the per-allocation refresh
+// walks only the classes whose SLAB contains the landed node (a CSR
+// inverted index), not every live heap. Expected refreshes per
+// allocation drop from #classes to #classes * K / N.
+//
+// Exhaustion (class heap runs dry) follows the kernel's refill
+// semantics: a slab that held every feasible-and-fitting-at-snapshot
+// node (cand_total <= K) is a FINAL verdict — idle only shrinks, so
+// nothing outside it can ever start fitting; a truncated slab WIDENS
+// to a full-N heap (the per-class refill round, counted in
+// out_stats[0]) and behaves like a masked SigHeap from then on. Past
+// kMaxWidened the refill falls back to a per-task dense scan
+// (out_stats[1]) so memory stays bounded. Job-break verdicts come from
+// cand_anyfeas (predicate-level feasibility at snapshot, matching the
+// masked scan's any_feasible; a node cap-saturated mid-solve is not
+// re-checked — its class simply never places there, same placements).
+int64_t greedy_allocate_sparse(
+    const float* task_req,        // [T, R]
+    const float* task_fit,        // [T, R]
+    const int32_t* task_queue,    // [T]
+    const int32_t* task_job,      // [T]
+    const uint8_t* task_valid,    // [T]
+    const int32_t* task_group,    // [T]
+    const uint8_t* node_feas,     // [N]
+    const uint8_t* group_feas,    // [G, N]
+    const int32_t* pair_idx,      // [P] ascending
+    const uint8_t* pair_feas,     // [P, N]
+    const int32_t* score_idx,     // [S] ascending
+    const float* score_rows,      // [S, N]
+    const float* node_idle0,      // [N, R]
+    const float* node_cap,        // [N, R]
+    const int32_t* node_task_count0,  // [N]
+    const int32_t* node_max_tasks,    // [N]
+    const float* queue_deserved,  // [Q, R]
+    const float* queue_alloc0,    // [Q, R]
+    const float* eps,             // [R]
+    double lr_w, double br_w,
+    int64_t T, int64_t N, int64_t Q, int64_t R,
+    int64_t G, int64_t P, int64_t S,
+    const int32_t* task_cand,     // [T] class id (out of range -> scan)
+    const int32_t* cand_idx,      // [C, K] node ids ascending, >= N pad
+    const float* cand_static,     // [C, K] static score slab
+    const int32_t* cand_total,    // [C] feasible+fit@snapshot count
+    const int32_t* cand_anyfeas,  // [C] any predicate-feasible node
+    int64_t C, int64_t K,
+    int64_t* out_stats,           // [4] refills, scans, inits, widened
+    int32_t* out_assign) {
+  std::vector<float> idle(node_idle0, node_idle0 + N * R);
+  std::vector<float> qalloc(queue_alloc0, queue_alloc0 + Q * R);
+  std::vector<int32_t> ntask(node_task_count0, node_task_count0 + N);
+  std::vector<uint8_t> job_failed(T, 0);
+  int64_t placed = 0;
+  int64_t pcur = 0, scur = 0;
+  int64_t refills = 0, scans = 0, inits = 0;
+
+  constexpr double kNegInf = -std::numeric_limits<double>::infinity();
+  constexpr size_t kMaxWidened = 256;  // full-N heaps are N doubles each
+
+  struct SlabHeap {
+    std::priority_queue<std::pair<double, int32_t>,
+                        std::vector<std::pair<double, int32_t>>,
+                        SigEntryLess>
+        heap;
+    // Per-slot sentinels pre-widen ([K], slots ascend by node id so the
+    // comparator's lowest-index tie rule still means lowest node), per
+    // NODE post-widen ([N]). NaN = removed/infeasible, -inf =
+    // fit-removed (permanent: idle only decreases), finite = live.
+    std::vector<double> cur;
+    const float* rep_req = nullptr;
+    const float* rep_fit = nullptr;
+    const uint8_t* grow = nullptr;
+    const uint8_t* prow = nullptr;
+    const float* srow = nullptr;
+    int64_t feas_uncapped = 0;  // maintained when widened (job verdicts)
+    bool init = false;
+    bool widened = false;
+  };
+  std::vector<SlabHeap> heaps(C);
+  std::vector<int32_t> widened_list;
+
+  // CSR inverted index node -> (class, slot) over the slabs, so an
+  // allocation refreshes only the classes that can still bid its node.
+  std::vector<int64_t> inv_start(N + 1, 0);
+  std::vector<int32_t> inv_class(static_cast<size_t>(C) * K);
+  std::vector<int32_t> inv_slot(static_cast<size_t>(C) * K);
+  {
+    for (int64_t c = 0; c < C; ++c)
+      for (int64_t s2 = 0; s2 < K; ++s2) {
+        const int32_t n = cand_idx[c * K + s2];
+        if (n >= 0 && n < N) ++inv_start[n + 1];
+      }
+    for (int64_t n = 0; n < N; ++n) inv_start[n + 1] += inv_start[n];
+    std::vector<int64_t> fill(inv_start.begin(), inv_start.end() - 1);
+    for (int64_t c = 0; c < C; ++c)
+      for (int64_t s2 = 0; s2 < K; ++s2) {
+        const int32_t n = cand_idx[c * K + s2];
+        if (n < 0 || n >= N) continue;
+        const int64_t at = fill[n]++;
+        inv_class[at] = static_cast<int32_t>(c);
+        inv_slot[at] = static_cast<int32_t>(s2);
+      }
+  }
+
+  auto node_score = [&](const SlabHeap& h, int64_t n) {
+    double s2 = score(h.rep_req, idle.data() + n * R, node_cap + n * R,
+                      lr_w, br_w);
+    if (h.srow) s2 += h.srow[n];
+    return s2;
+  };
+
+  auto widen = [&](SlabHeap& h) {
+    h.widened = true;
+    h.heap = {};
+    h.cur.assign(N, std::numeric_limits<double>::quiet_NaN());
+    h.feas_uncapped = 0;
+    for (int64_t n = 0; n < N; ++n) {
+      if (!node_feas[n]) continue;
+      if (h.grow && !h.grow[n]) continue;
+      if (h.prow && !h.prow[n]) continue;
+      if (node_max_tasks[n] > 0 && ntask[n] >= node_max_tasks[n]) continue;
+      ++h.feas_uncapped;
+      const double s2 = node_score(h, n);
+      h.cur[n] = s2;
+      h.heap.push({s2, static_cast<int32_t>(n)});
+    }
+  };
+
+  auto apply_allocate = [&](int64_t t, int64_t n) {
+    const float* req = task_req + t * R;
+    float* nidle = idle.data() + n * R;
+    for (int64_t d = 0; d < R; ++d) nidle[d] -= req[d];
+    ntask[n] += 1;
+    const int64_t q = task_queue[t];
+    if (q >= 0 && q < Q) {
+      float* qa = qalloc.data() + q * R;
+      for (int64_t d = 0; d < R; ++d) qa[d] += req[d];
+    }
+    out_assign[t] = static_cast<int32_t>(n);
+    ++placed;
+    const bool capped =
+        node_max_tasks[n] > 0 && ntask[n] >= node_max_tasks[n];
+    // Slab classes holding node n (the sparse win: ~C*K/N of them).
+    for (int64_t at = inv_start[n]; at < inv_start[n + 1]; ++at) {
+      SlabHeap& h = heaps[inv_class[at]];
+      if (!h.init || h.widened) continue;
+      const int32_t slot = inv_slot[at];
+      const double c2 = h.cur[slot];
+      if (std::isnan(c2)) continue;
+      if (capped) {
+        h.cur[slot] = std::numeric_limits<double>::quiet_NaN();
+        continue;
+      }
+      if (c2 == kNegInf) continue;
+      const double ns =
+          score(h.rep_req, nidle, node_cap + n * R, lr_w, br_w) +
+          cand_static[static_cast<int64_t>(inv_class[at]) * K + slot];
+      h.cur[slot] = ns;
+      h.heap.push({ns, slot});
+    }
+    // Widened classes see every node (masked SigHeap behavior).
+    for (const int32_t c : widened_list) {
+      SlabHeap& h = heaps[c];
+      const double c2 = h.cur[n];
+      if (std::isnan(c2)) continue;
+      if (capped) {
+        h.cur[n] = std::numeric_limits<double>::quiet_NaN();
+        --h.feas_uncapped;
+        continue;
+      }
+      if (c2 == kNegInf) continue;
+      const double ns = node_score(h, n);
+      h.cur[n] = ns;
+      h.heap.push({ns, static_cast<int32_t>(n)});
+    }
+  };
+
+  for (int64_t t = 0; t < T; ++t) {
+    out_assign[t] = -1;
+    while (pcur < P && pair_idx[pcur] < t) ++pcur;
+    while (scur < S && score_idx[scur] < t) ++scur;
+    const uint8_t* prow =
+        (pcur < P && pair_idx[pcur] == t) ? pair_feas + pcur * N : nullptr;
+    const float* srow =
+        (scur < S && score_idx[scur] == t) ? score_rows + scur * N : nullptr;
+
+    if (!task_valid[t]) continue;
+    const int64_t j = task_job[t];
+    if (j >= 0 && j < T && job_failed[j]) continue;
+    const float* req = task_req + t * R;
+    const float* fit = task_fit + t * R;
+    const int64_t q = task_queue[t];
+    if (q >= 0 && q < Q &&
+        overused(queue_deserved + q * R, qalloc.data() + q * R, eps, R)) {
+      continue;
+    }
+    const uint8_t* grow =
+        (task_group[t] >= 0 && task_group[t] < G)
+            ? group_feas + task_group[t] * N
+            : nullptr;
+
+    // Full dense scan (fallback for out-of-range class ids and for
+    // widen-budget overflow): the masked loop's scan path, serial.
+    auto scan_allocate = [&]() {
+      int64_t best = -1;
+      double best_score = -1.0e300;
+      bool any_feasible = false;
+      for (int64_t n = 0; n < N; ++n) {
+        if (!node_feas[n]) continue;
+        if (grow && !grow[n]) continue;
+        if (prow && !prow[n]) continue;
+        if (node_max_tasks[n] > 0 && ntask[n] >= node_max_tasks[n])
+          continue;
+        any_feasible = true;
+        if (!fits(fit, idle.data() + n * R, eps, R)) continue;
+        double s2 = score(req, idle.data() + n * R, node_cap + n * R,
+                          lr_w, br_w);
+        if (srow) s2 += srow[n];
+        if (s2 > best_score) {
+          best_score = s2;
+          best = n;
+        }
+      }
+      if (best >= 0) {
+        apply_allocate(t, best);
+      } else if (!any_feasible && j >= 0 && j < T) {
+        job_failed[j] = 1;
+      }
+    };
+
+    const int64_t cid = task_cand ? task_cand[t] : -1;
+    if (cid < 0 || cid >= C) {
+      ++scans;
+      scan_allocate();
+      continue;
+    }
+    SlabHeap& h = heaps[cid];
+    if (!h.init) {
+      h.init = true;
+      ++inits;
+      h.rep_req = req;
+      h.rep_fit = fit;
+      h.grow = grow;
+      h.prow = prow;
+      h.srow = srow;
+      h.cur.assign(K, std::numeric_limits<double>::quiet_NaN());
+      for (int64_t s2 = 0; s2 < K; ++s2) {
+        const int32_t n = cand_idx[cid * K + s2];
+        if (n < 0 || n >= N) continue;
+        // Selection vetted predicates/fit at snapshot; caps may have
+        // filled since (this very solve), so re-check them here.
+        if (node_max_tasks[n] > 0 && ntask[n] >= node_max_tasks[n])
+          continue;
+        const double sc =
+            score(req, idle.data() + n * R, node_cap + n * R, lr_w,
+                  br_w) +
+            cand_static[cid * K + s2];
+        h.cur[s2] = sc;
+        h.heap.push({sc, static_cast<int32_t>(s2)});
+      }
+    }
+
+    auto pop_best = [&]() -> int64_t {
+      while (!h.heap.empty()) {
+        const auto top = h.heap.top();
+        const int32_t i = top.second;
+        if (top.first != h.cur[i]) {  // stale (NaN/-inf compare false)
+          h.heap.pop();
+          continue;
+        }
+        const int64_t n = h.widened ? i : cand_idx[cid * K + i];
+        if (!fits(h.rep_fit, idle.data() + n * R, eps, R)) {
+          h.cur[i] = kNegInf;  // permanent: idle only decreases
+          h.heap.pop();
+          continue;
+        }
+        return n;
+      }
+      return -1;
+    };
+
+    int64_t best = pop_best();
+    if (best < 0 && !h.widened && cand_total[cid] > K) {
+      // Truncated slab exhausted: refill. Widen to a full-N heap when
+      // the budget allows, else per-task dense scan.
+      if (widened_list.size() < kMaxWidened) {
+        widen(h);
+        widened_list.push_back(static_cast<int32_t>(cid));
+        ++refills;
+        best = pop_best();
+      } else {
+        ++scans;
+        scan_allocate();
+        continue;
+      }
+    }
+    if (best < 0) {
+      if (h.widened) {
+        // Widened heaps track cap removals exactly like a masked
+        // SigHeap: feas_uncapped IS the current any_feasible.
+        if (h.feas_uncapped == 0 && j >= 0 && j < T) job_failed[j] = 1;
+      } else if (cand_anyfeas[cid] == 0) {
+        // No predicate-feasible cap-open node even at snapshot time;
+        // caps only saturate, so none exists now either.
+        if (j >= 0 && j < T) job_failed[j] = 1;
+      } else {
+        // Complete slab exhausted but the class HAD feasible nodes at
+        // snapshot: the job-break verdict depends on CURRENT pod-count
+        // caps (a node saturating mid-solve must break the job exactly
+        // like the masked loop). The scan cannot place the task — the
+        // slab held every feasible+fit@snapshot node and idle only
+        // shrinks — but it recomputes any_feasible at current state,
+        // giving the masked loop's verdict bit-for-bit.
+        ++scans;
+        scan_allocate();
+      }
+      continue;
+    }
+    apply_allocate(t, best);
+  }
+  if (out_stats) {
+    out_stats[0] = refills;
+    out_stats[1] = scans;
+    out_stats[2] = inits;
+    out_stats[3] = static_cast<int64_t>(widened_list.size());
+  }
+  return placed;
+}
+
 }  // extern "C"

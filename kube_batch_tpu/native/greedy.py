@@ -100,6 +100,23 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             i32p,
         ]
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.greedy_allocate_sparse.restype = ctypes.c_int64
+        lib.greedy_allocate_sparse.argtypes = [
+            f32p, f32p, i32p, i32p, u8p, i32p,      # task req/fit/queue/job/valid/group
+            u8p, u8p,                               # node_feas, group_feas
+            i32p, u8p,                              # pair_idx, pair_feas
+            i32p, f32p,                             # score_idx, score_rows
+            f32p, f32p, i32p, i32p,                 # node idle/cap/task_count/max_tasks
+            f32p, f32p, f32p,                       # queue deserved/alloc, eps
+            ctypes.c_double, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i32p, i32p, f32p, i32p, i32p,           # task_cand, cand slabs
+            ctypes.c_int64, ctypes.c_int64,         # C, K
+            i64p,                                   # out_stats[4]
+            i32p,
+        ]
         _lib = lib
         return lib
 
@@ -145,9 +162,17 @@ def greedy_allocate(
     return out, int(placed)
 
 
+# Forensics of the most recent solve_native (sparse engagement + refill
+# counts for bench/metrics attribution). Single-threaded by construction,
+# like actions.allocate_tpu.last_stats (one in-flight native solve).
+last_solve_stats: dict = {}
+
+
 def solve_native(inputs) -> Tuple[np.ndarray, int]:
     """Production CPU fallback: run greedy.cpp's feasibility-aware loop
-    (greedy_allocate_masked) on a solver :class:`PackedInputs` bundle.
+    on a solver :class:`PackedInputs` bundle — the candidate-sparsified
+    ``greedy_allocate_sparse`` when the snapshot carries top-K candidate
+    slabs (solver/topk.py), ``greedy_allocate_masked`` otherwise.
 
     Consumes the SAME factorized snapshot the TPU kernel consumes —
     predicate groups/pairs, init-resreq fit vs resreq subtract, static
@@ -155,7 +180,9 @@ def solve_native(inputs) -> Tuple[np.ndarray, int]:
     job-break semantics (allocate.go:144-148). Returns
     ``(assignment i32[T], placed)`` with node indices into the unfiltered
     (padded) node table, matching ``SolveResult.assigned``'s contract so
-    ``allocate_tpu`` can apply either interchangeably."""
+    ``allocate_tpu`` can apply either interchangeably. Sparse-path
+    forensics (refill rounds, fallback scans) land in
+    :data:`last_solve_stats`."""
     lib = _load()
     # PackedInputs (the transfer bundle) or bare SolverInputs — same
     # dispatch as solve_auto's isinstance check, via hasattr so this
@@ -181,6 +208,48 @@ def solve_native(inputs) -> Tuple[np.ndarray, int]:
     pair_idx, pair_feas = i32(s.pair_idx), u8(s.pair_feas)
     score_idx, score_rows = i32(s.score_idx), f32(s.score_rows)
     out = np.empty(T, dtype=np.int32)
+    last_solve_stats.clear()
+
+    cand_idx = getattr(s, "cand_idx", None)
+    task_cand = getattr(s, "task_cand", None)
+    sparse = (
+        cand_idx is not None
+        and task_cand is not None
+        and np.asarray(cand_idx).shape[0] > 0
+    )
+    if sparse:
+        cand_idx = i32(cand_idx)
+        C, K = cand_idx.shape
+        cand_static = f32(s.cand_static)
+        cand_info = i32(s.cand_info)
+        stats = np.zeros(4, dtype=np.int64)
+        placed = lib.greedy_allocate_sparse(
+            task_req, task_fit, i32(s.task_queue), i32(s.task_job),
+            u8(s.task_valid), i32(s.task_group),
+            u8(s.node_feas), group_feas,
+            pair_idx, pair_feas,
+            score_idx, score_rows,
+            node_idle, node_cap, i32(s.node_task_count),
+            i32(s.node_max_tasks),
+            queue_deserved, f32(s.queue_allocated), f32(s.eps),
+            float(np.asarray(s.lr_weight)), float(np.asarray(s.br_weight)),
+            T, N, Q, R,
+            group_feas.shape[0], pair_idx.shape[0], score_idx.shape[0],
+            i32(task_cand), cand_idx,
+            np.ascontiguousarray(cand_static),
+            np.ascontiguousarray(cand_info[0]),
+            np.ascontiguousarray(cand_info[1]),
+            C, K,
+            stats,
+            out,
+        )
+        last_solve_stats.update(
+            sparse=True, k=int(K), classes=int(C),
+            refill_rounds=int(stats[0]), fallback_scans=int(stats[1]),
+            class_inits=int(stats[2]), widened=int(stats[3]),
+        )
+        return out, int(placed)
+
     placed = lib.greedy_allocate_masked(
         task_req, task_fit, i32(s.task_queue), i32(s.task_job),
         u8(s.task_valid), i32(s.task_group),
@@ -194,4 +263,5 @@ def solve_native(inputs) -> Tuple[np.ndarray, int]:
         group_feas.shape[0], pair_idx.shape[0], score_idx.shape[0],
         out,
     )
+    last_solve_stats.update(sparse=False)
     return out, int(placed)

@@ -282,7 +282,19 @@ class PredicatesPlugin(Plugin):
             O(N + G·N) host work instead of O(T·N). Host ports and
             inter-pod (anti-)affinity depend on per-node session state and
             get private per-task rows (sparse: only tasks that carry
-            them)."""
+            them).
+
+            This factorization is ALSO what the top-K candidate
+            selection pass consumes (solver/topk.py): combine_masks
+            folds these parts into CombinedMask, whose ``rows_for``
+            emits per-class candidate-column masks — one row per
+            distinct (group, req/fit, private-row) class, not per task.
+            A custom plugin returning a dense [T, N] mask still works
+            (combine_masks dedups identical rows into groups), but any
+            per-task row variance it introduces multiplies the class
+            count and can push the selection pass over its budget
+            (dense fallback, reason "class-budget") — prefer BatchMask's
+            group/pair form."""
             from ..solver.masks import BatchMask
 
             T, N = len(tasks), len(nodes)

@@ -22,10 +22,13 @@ from .kernels import (
     solve_auto,
     solve_full_jit,
     solve_jit,
+    solve_sparse,
+    solve_sparse_jit,
     solve_staged,
     solve_staged_jit,
 )
 from .masks import BatchMask, CombinedMask, combine_masks, combine_score_rows
+from .topk import TopKConfig, select_candidates, topk_config
 from .sharding import (
     default_mesh,
     init_distributed,
@@ -66,9 +69,14 @@ __all__ = [
     "solve_full_jit",
     "solve_jit",
     "solve_sharded",
+    "solve_sparse",
+    "solve_sparse_jit",
     "solve_spmd",
     "spmd_shardings_for",
     "solve_staged",
     "solve_staged_jit",
+    "select_candidates",
     "tensorize",
+    "topk_config",
+    "TopKConfig",
 ]

@@ -75,6 +75,11 @@ _ROW_AXIS = {
     "score_rows": 0,
     "queue_f32": 1,
     "misc": 0,
+    # Candidate slabs (solver/topk.py): class-row deltas, same donated
+    # row-scatter machinery as the other factorized rows.
+    "cand_idx": 0,
+    "cand_static": 0,
+    "cand_info": 1,
 }
 
 # Past this dirty fraction a full upload beats row patching (mirrors
@@ -214,6 +219,7 @@ class DeviceSnapshotCache:
             "uploads": 0,
             "rows_patched": 0,
             "bytes_shipped": 0,
+            "slab_bytes_shipped": 0,
             "bytes_total": 0,
             "full_reasons": {},
             "field_outcomes": {},
@@ -221,33 +227,35 @@ class DeviceSnapshotCache:
         fields: Dict[str, object] = {}
         for name, arr in arrays.items():
             stats["bytes_total"] += arr.nbytes
+            shipped_before = stats["bytes_shipped"]
             cached = self.host.get(name)
             dev = self.dev.get(name)
             if cached is None or dev is None:
                 fields[name] = self._upload(name, arr, "cold", stats)
-                continue
-            if cached.shape != arr.shape or cached.dtype != arr.dtype:
+            elif cached.shape != arr.shape or cached.dtype != arr.dtype:
                 fields[name] = self._upload(
                     name, arr, "shape-change", stats
                 )
-                continue
-            rows, nrows = self._diff_rows(name, arr, cached)
-            if rows.size == 0:
-                fields[name] = dev
-                stats["reuses"] += 1
-                stats["field_outcomes"][name] = "reuse"
-                continue
-            if arr.nbytes < _MIN_PATCH_BYTES:
-                fields[name] = self._upload(
-                    name, arr, "small-buffer", stats
+            else:
+                rows, nrows = self._diff_rows(name, arr, cached)
+                if rows.size == 0:
+                    fields[name] = dev
+                    stats["reuses"] += 1
+                    stats["field_outcomes"][name] = "reuse"
+                elif arr.nbytes < _MIN_PATCH_BYTES:
+                    fields[name] = self._upload(
+                        name, arr, "small-buffer", stats
+                    )
+                elif rows.size * _BULK_DIRTY_DEN > nrows:
+                    fields[name] = self._upload(
+                        name, arr, "bulk-dirty", stats
+                    )
+                else:
+                    fields[name] = self._patch(name, arr, rows, stats)
+            if name.startswith("cand"):
+                stats["slab_bytes_shipped"] += (
+                    stats["bytes_shipped"] - shipped_before
                 )
-                continue
-            if rows.size * _BULK_DIRTY_DEN > nrows:
-                fields[name] = self._upload(
-                    name, arr, "bulk-dirty", stats
-                )
-                continue
-            fields[name] = self._patch(name, arr, rows, stats)
 
         last_pack_stats.clear()
         last_pack_stats.update(stats)

@@ -49,11 +49,14 @@ dimension, with eps = (10 mCPU, 10 MiB, 10 milli-units...).
 
 from __future__ import annotations
 
+import logging
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+logger = logging.getLogger(__name__)
 
 # Resource-dimension layout contract (see snapshot.ResourceLayout).
 CPU_DIM = 0
@@ -100,6 +103,16 @@ class SolverInputs(NamedTuple):
     eps: jnp.ndarray             # f32[R] per-dimension epsilon
     lr_weight: jnp.ndarray       # f32[] LeastRequested weight
     br_weight: jnp.ndarray       # f32[] BalancedResourceAllocation weight
+    # Top-K candidate sparsification (solver/topk.py). None/empty = dense.
+    # Tasks sharing (feasibility group, req, fit, private rows) share one
+    # candidate CLASS; cand_idx rows hold each class's candidate node ids
+    # ascending (>= N entries are padding). cand_info rows: 0 = count of
+    # feasible-and-fitting-at-snapshot nodes (refill gauge vs K), 1 = any
+    # predicate-feasible node exists, 2 = class fits some Releasing row.
+    task_cand: jnp.ndarray = None    # i32[T] candidate class per task
+    cand_idx: jnp.ndarray = None     # i32[C, K] candidate node ids
+    cand_static: jnp.ndarray = None  # f32[C, K] static score slab
+    cand_info: jnp.ndarray = None    # i32[3, C]
 
 
 class PackedInputs(NamedTuple):
@@ -112,7 +125,7 @@ class PackedInputs(NamedTuple):
     """
 
     task_f32: jnp.ndarray   # [2, T, R] req, fit
-    task_i32: jnp.ndarray   # [5, T] rank, queue, job, group, valid
+    task_i32: jnp.ndarray   # [6, T] rank, queue, job, group, valid, cand
     node_f32: jnp.ndarray   # [3, N, R] idle, releasing, cap
     node_i32: jnp.ndarray   # [3, N] task_count, max_tasks, feas
     group_feas: jnp.ndarray # bool[G, N]
@@ -122,9 +135,18 @@ class PackedInputs(NamedTuple):
     score_rows: jnp.ndarray # f32[S, N]
     queue_f32: jnp.ndarray  # [2, Q, R] deserved, allocated
     misc: jnp.ndarray       # f32[R + 2] eps, lr_weight, br_weight
+    # Candidate slabs (see SolverInputs). [0, K]-shaped when dense; None
+    # only on legacy hand-built bundles.
+    cand_idx: jnp.ndarray = None     # i32[C, K]
+    cand_static: jnp.ndarray = None  # f32[C, K]
+    cand_info: jnp.ndarray = None    # i32[3, C]
 
     def unpack(self) -> "SolverInputs":
         R = self.task_f32.shape[2]
+        # Row 5 (candidate class) is absent on legacy 5-row bundles.
+        task_cand = (
+            self.task_i32[5] if self.task_i32.shape[0] > 5 else None
+        )
         return SolverInputs(
             task_req=self.task_f32[0],
             task_fit=self.task_f32[1],
@@ -133,6 +155,10 @@ class PackedInputs(NamedTuple):
             task_job=self.task_i32[2],
             task_group=self.task_i32[3],
             task_valid=self.task_i32[4].astype(bool),
+            task_cand=task_cand,
+            cand_idx=self.cand_idx,
+            cand_static=self.cand_static,
+            cand_info=self.cand_info,
             node_feas=self.node_i32[2].astype(bool),
             group_feas=self.group_feas,
             pair_idx=self.pair_idx,
@@ -222,6 +248,9 @@ class SolverResult(NamedTuple):
     queue_allocated: jnp.ndarray  # f32[Q, R]
     rounds: jnp.ndarray           # i32[] rounds executed
     stages: jnp.ndarray = None    # i32[] tail compaction stages (staged only)
+    refills: jnp.ndarray = None   # i32[] tasks routed to candidate refill
+                                  # (sparse only; stages counts the refill
+                                  # rounds those tasks then ran)
 
 
 def less_equal(a: jnp.ndarray, b: jnp.ndarray, eps: jnp.ndarray) -> jnp.ndarray:
@@ -579,20 +608,48 @@ def _solve_round(
     return assigned, idle, ntask, qalloc, failed, any_accept
 
 
+# Cached backend probe + per-decision log for the Pallas gate.
+# jax.default_backend() is cheap once initialized but the first call can
+# be an expensive (or, behind a wedged tunnel, hanging) platform init —
+# and the gate used to re-consult it on every solve trace. The backend
+# cannot change within a process, so probe once; the env flag stays
+# dynamic (tests toggle KBT_PALLAS) but each distinct decision is logged
+# exactly once instead of every cycle.
+_pallas_probe_cache: dict = {}
+
+
+def _pallas_backend() -> str:
+    if "backend" not in _pallas_probe_cache:
+        try:
+            _pallas_probe_cache["backend"] = jax.default_backend()
+        except Exception:  # pragma: no cover
+            _pallas_probe_cache["backend"] = ""
+    return _pallas_probe_cache["backend"]
+
+
 def _should_use_pallas() -> bool:
     """Trace-time gate for the fused Pallas bid pass: opt-in via
     KBT_PALLAS=1 and TPU backend only. The kernel itself handles any T
     (internal padding to TILE_T) and static plugin score rows, so the
-    standard nodeorder/affinity configuration runs fused too."""
+    standard nodeorder/affinity configuration runs fused too. The
+    backend probe is cached for process lifetime and the decision is
+    logged once per (flag, backend) combination, not per solve."""
     from .pallas_kernels import pallas_enabled
 
-    if not pallas_enabled():
-        return False
-    try:
-        backend = jax.default_backend()
-    except Exception:  # pragma: no cover
-        return False
-    return backend == "tpu"
+    enabled = pallas_enabled()
+    decision = enabled and _pallas_backend() == "tpu"
+    key = (enabled, _pallas_backend() if enabled else "")
+    if _pallas_probe_cache.get("logged") != key:
+        _pallas_probe_cache["logged"] = key
+        if enabled:
+            logger.info(
+                "pallas bid pass %s (KBT_PALLAS=1, backend=%s)",
+                "ENABLED" if decision else "disabled",
+                key[1] or "unknown",
+            )
+        else:
+            logger.debug("pallas bid pass disabled (KBT_PALLAS unset)")
+    return decision
 
 
 def solve(inputs: SolverInputs, max_rounds: int = 256,
@@ -749,6 +806,126 @@ def tail_local_blocked(inputs: SolverInputs, idxs, B):
     return blocked_from, rank2
 
 
+def _dense_tail(
+    inputs: SolverInputs,
+    assigned, idle, ntask, qalloc, failed, rounds,
+    *,
+    fits_releasing, job_blocked, shared_kw,
+    max_rounds: int, tail_bucket: int,
+):
+    """Compacted dense drain stage shared by :func:`solve_staged` (its
+    tail) and :func:`solve_sparse` (candidate-refill / dense-fallback
+    rounds): repeatedly compact the highest-priority eligible tasks into
+    a fixed ``[tail_bucket]`` block and run full-width-over-N rounds on
+    it until nothing progresses. Semantics documented at
+    :func:`solve_staged`. Returns
+    ``(assigned, idle, ntask, qalloc, failed, rounds, stages)``."""
+    eps = inputs.eps
+    # Clamp to the task axis: the sparse solver drains refills through
+    # here at ANY T (solve_staged only enters past T > tail_bucket).
+    B = min(tail_bucket, int(inputs.task_req.shape[0]))
+
+    def tail_outer_body(ostate):
+        assigned, idle, ntask, qalloc, failed, _, rounds, stages = ostate
+
+        blocked = job_blocked(failed)
+        # qalloc only grows during a solve, so an overused queue stays
+        # overused — its tasks are permanently gated and must not crowd
+        # actionable tasks out of the bucket.
+        q_over = less_equal(inputs.queue_deserved, qalloc, eps)
+        elig = (
+            (assigned < 0)
+            & inputs.task_valid
+            & ~failed
+            & ~blocked
+            & ~q_over[inputs.task_queue]
+        )
+        sel_key = jnp.where(elig, inputs.task_rank, _INT_MAX)
+        # Highest-priority (smallest-rank) eligible tasks; stable order.
+        _, idxs = lax.top_k(-sel_key, B)
+        idxs = idxs.astype(jnp.int32)
+        valid2 = sel_key[idxs] != _INT_MAX
+
+        req2 = inputs.task_req[idxs]
+        fit2 = inputs.task_fit[idxs]
+        queue2 = inputs.task_queue[idxs]
+        feas2 = tail_subset_feas(inputs, idxs, valid2)
+        static2 = tail_subset_static(inputs, idxs)
+        fits_rel2 = fits_releasing[idxs]
+        blocked_from, rank2 = tail_local_blocked(inputs, idxs, B)
+
+        tail_kw = dict(
+            task_req=req2, task_fit=fit2,
+            task_rank=rank2, task_queue=queue2,
+            task_sel=valid2, task_ids=idxs,
+            feas=feas2, static_score=static2,
+            fits_releasing=fits_rel2, blocked_of=blocked_from,
+            **shared_kw,
+        )
+
+        def tail_body(state):
+            (
+                sub_assigned, idle, ntask, qalloc, failed2, _, rnd
+            ) = state
+            (
+                sub_assigned, idle, ntask, qalloc, failed2, any_accept
+            ) = _solve_round(
+                sub_assigned, idle, ntask, qalloc, failed2, **tail_kw
+            )
+            return (
+                sub_assigned, idle, ntask, qalloc, failed2,
+                any_accept, rnd + 1,
+            )
+
+        def tail_cond(state):
+            changed, rnd = state[5], state[6]
+            return changed & (rnd < max_rounds)
+
+        tstate = (
+            jnp.full((B,), -1, jnp.int32), idle, ntask, qalloc,
+            failed[idxs], jnp.array(True), rounds,
+        )
+        (
+            sub_assigned, idle, ntask, qalloc, failed2, _, rounds
+        ) = lax.while_loop(tail_cond, tail_body, tstate)
+
+        placed2 = sub_assigned >= 0
+        assigned = assigned.at[idxs].set(
+            jnp.where(placed2, sub_assigned, assigned[idxs])
+        )
+        failed = failed.at[idxs].set(failed2)
+        return (
+            assigned, idle, ntask, qalloc, failed,
+            jnp.any(placed2), rounds, stages + 1,
+        )
+
+    def tail_outer_cond(ostate):
+        progressed, rounds, stages = ostate[5], ostate[6], ostate[7]
+        # Continue while the last stage placed something, tasks remain,
+        # and budgets allow. A stage that places nothing ends the solve
+        # (every remaining task is failed, blocked, over-budget, or
+        # waiting on Releasing resources).
+        assigned, qalloc, failed = ostate[0], ostate[3], ostate[4]
+        q_over = less_equal(inputs.queue_deserved, qalloc, eps)
+        remaining = jnp.any(
+            (assigned < 0) & inputs.task_valid & ~failed
+            & ~job_blocked(failed) & ~q_over[inputs.task_queue]
+        )
+        return (
+            progressed & remaining & (rounds < max_rounds)
+            & (stages < 64)
+        )
+
+    ostate = (
+        assigned, idle, ntask, qalloc, failed,
+        jnp.array(True), rounds, jnp.array(0, jnp.int32),
+    )
+    (
+        assigned, idle, ntask, qalloc, failed, _, rounds, stages
+    ) = lax.while_loop(tail_outer_cond, tail_outer_body, ostate)
+    return assigned, idle, ntask, qalloc, failed, rounds, stages
+
+
 def solve_staged(
     inputs: SolverInputs,
     max_rounds: int = 256,
@@ -874,107 +1051,263 @@ def solve_staged(
     ) = lax.while_loop(head_cond, head_body, init)
 
     # ---------------- tail: compacted rounds ---------------------------
-    B = tail_bucket
+    (
+        assigned, idle, _, qalloc, _, rounds, stages
+    ) = _dense_tail(
+        inputs, assigned, idle, ntask, qalloc, failed, rounds,
+        fits_releasing=fits_releasing, job_blocked=job_blocked,
+        shared_kw=shared_kw, max_rounds=max_rounds,
+        tail_bucket=tail_bucket,
+    )
+    return SolverResult(assigned, idle, qalloc, rounds, stages)
 
-    def tail_outer_body(ostate):
-        assigned, idle, ntask, qalloc, failed, _, rounds, stages = ostate
 
-        blocked = job_blocked(failed)
-        # qalloc only grows during a solve, so an overused queue stays
-        # overused — its tasks are permanently gated and must not crowd
-        # actionable tasks out of the bucket.
-        q_over = less_equal(inputs.queue_deserved, qalloc, eps)
-        elig = (
-            (assigned < 0)
-            & inputs.task_valid
-            & ~failed
-            & ~blocked
-            & ~q_over[inputs.task_queue]
+def _sparse_round(
+    assigned, idle, ntask, qalloc, failed, refill,
+    *, task_req, task_fit, task_rank, task_queue, task_sel, task_ids,
+    cand_nodes, cand_static, cand_total, fits_releasing, blocked_of,
+    node_cap, node_max_tasks, queue_deserved,
+    lr_weight, br_weight, eps, use_pallas=False,
+):
+    """ONE candidate-sparsified solver round: the dense round's
+    gate/mask/fail/score/bid/commit chain (:func:`_solve_round`) run on
+    gathered [T, K] candidate slabs instead of [T, N] matrices. Bids
+    carry GLOBAL node ids (``cand_nodes``), so conflict resolution and
+    node capacity accounting stay dense [N] inside :func:`_commit_bids`
+    (segment scatters keyed by node id) — only the mask/score/key pass
+    shrinks from O(T·N) to O(T·K).
+
+    Slab exhaustion (no candidate fits CURRENT idle) splits two ways on
+    ``cand_total`` (the class's feasible-and-fitting node count at
+    snapshot time, solver/topk.py): a slab that held EVERY such node
+    reproduces the dense solver's permanent no-fit verdict exactly —
+    idle only shrinks during a solve, so a node outside that set can
+    never start fitting — while a truncated slab (cand_total > K)
+    routes the task to the refill stage (``refill`` flag; drained by
+    :func:`_dense_tail`), never to a false job break.
+
+    Returns (assigned, idle, ntask, qalloc, failed, refill, any_accept).
+    """
+    N = idle.shape[0]
+    K = cand_nodes.shape[1]
+    T = task_req.shape[0]
+    pending = assigned < 0
+    q_over = less_equal(queue_deserved, qalloc, eps)
+    task_ok = (
+        pending & task_sel & ~q_over[task_queue] & ~blocked_of(failed)
+        & ~refill
+    )
+    cap_ok = (node_max_tasks == 0) | (ntask < node_max_tasks)
+    valid = cand_nodes < N                               # [T, K]
+    safe = jnp.minimum(cand_nodes, N - 1)                # gather-safe ids
+    arange_t = jnp.arange(T, dtype=jnp.int32)
+
+    if use_pallas:
+        # Fused tile-resident slab bid pass (pallas_kernels.py); same
+        # single-commit structure as the dense pallas round.
+        from .pallas_kernels import pallas_bid_sparse
+
+        bid, any_feas = pallas_bid_sparse(
+            task_fit, task_req, task_ok, cand_nodes, cand_static,
+            idle, node_cap, cap_ok, eps, lr_weight, br_weight,
         )
-        sel_key = jnp.where(elig, inputs.task_rank, INT_MAX)
-        # Highest-priority (smallest-rank) eligible tasks; stable order.
-        _, idxs = lax.top_k(-sel_key, B)
-        idxs = idxs.astype(jnp.int32)
-        valid2 = sel_key[idxs] != INT_MAX
-
-        req2 = inputs.task_req[idxs]
-        fit2 = inputs.task_fit[idxs]
-        queue2 = inputs.task_queue[idxs]
-        feas2 = tail_subset_feas(inputs, idxs, valid2)
-        static2 = tail_subset_static(inputs, idxs)
-        fits_rel2 = fits_releasing[idxs]
-        blocked_from, rank2 = tail_local_blocked(inputs, idxs, B)
-
-        tail_kw = dict(
-            task_req=req2, task_fit=fit2,
-            task_rank=rank2, task_queue=queue2,
-            task_sel=valid2, task_ids=idxs,
-            feas=feas2, static_score=static2,
-            fits_releasing=fits_rel2, blocked_of=blocked_from,
-            **shared_kw,
+        exhausted = task_ok & ~any_feas
+        failed = failed | (
+            exhausted & (cand_total <= K) & ~fits_releasing
         )
-
-        def tail_body(state):
-            (
-                sub_assigned, idle, ntask, qalloc, failed2, _, rnd
-            ) = state
-            (
-                sub_assigned, idle, ntask, qalloc, failed2, any_accept
-            ) = _solve_round(
-                sub_assigned, idle, ntask, qalloc, failed2, **tail_kw
-            )
-            return (
-                sub_assigned, idle, ntask, qalloc, failed2,
-                any_accept, rnd + 1,
-            )
-
-        def tail_cond(state):
-            changed, rnd = state[5], state[6]
-            return changed & (rnd < max_rounds)
-
-        tstate = (
-            jnp.full((B,), -1, jnp.int32), idle, ntask, qalloc,
-            failed[idxs], jnp.array(True), rounds,
+        refill = refill | (exhausted & (cand_total > K))
+        bid = jnp.where(blocked_of(failed) | refill, N, bid)
+        assigned, idle, ntask, qalloc, any_accept = _commit_bids(
+            bid, assigned, idle, ntask, qalloc,
+            task_req=task_req, task_fit=task_fit,
+            task_rank=task_rank, task_queue=task_queue,
+            node_max_tasks=node_max_tasks,
+            queue_deserved=queue_deserved, eps=eps,
         )
+        return assigned, idle, ntask, qalloc, failed, refill, any_accept
+
+    idle_slab = idle[safe]                               # [T, K, R]
+    fits = less_equal(task_fit[:, None, :], idle_slab, eps)
+    mask = fits & valid & cap_ok[safe] & task_ok[:, None]
+
+    exhausted = task_ok & ~jnp.any(mask, axis=1)
+    failed = failed | (
+        exhausted & (cand_total <= K) & ~fits_releasing
+    )
+    refill = refill | (exhausted & (cand_total > K))
+    mask = mask & ~(blocked_of(failed) | refill)[:, None]
+
+    dims = (CPU_DIM, MEM_DIM)
+    score = _dyn_score_core(
+        task_req[:, None, dims],
+        idle_slab[..., dims],
+        node_cap[safe][..., dims],
+        lr_weight, br_weight,
+    ) + cand_static
+    # GLOBAL task/node ids in the hash bits: a task's tie-break for a
+    # node is identical on the sparse and dense paths, so a slab that
+    # covers every eligible node (K >= cand_total) reproduces the dense
+    # argmax bit-for-bit (candidates are stored ascending by node id,
+    # matching argmax's first-max tie rule).
+    key = bid_keys(score, task_ids[:, None], cand_nodes)
+    key = jnp.where(mask, key, -1)
+
+    def commit_once(_, state):
+        assigned, idle, ntask, qalloc, any_acc, key = state
+        live = assigned < 0
+        bid_col = jnp.argmax(key, axis=1).astype(jnp.int32)
+        has_bid = live & (key[arange_t, bid_col] >= 0)
+        bid = jnp.where(has_bid, cand_nodes[arange_t, bid_col], N)
+        assigned, idle, ntask, qalloc, acc = _commit_bids(
+            bid, assigned, idle, ntask, qalloc,
+            task_req=task_req, task_fit=task_fit,
+            task_rank=task_rank, task_queue=task_queue,
+            node_max_tasks=node_max_tasks,
+            queue_deserved=queue_deserved, eps=eps,
+        )
+        # Losers stop re-bidding the slab column they just lost this
+        # round (fresh scores next round may still pick it).
+        lost = has_bid & (assigned < 0)
+        col = jnp.where(has_bid, bid_col, 0)
+        key = key.at[arange_t, col].set(
+            jnp.where(lost, -1, key[arange_t, col])
+        )
+        return assigned, idle, ntask, qalloc, any_acc | acc, key
+
+    assigned, idle, ntask, qalloc, any_accept, _ = lax.fori_loop(
+        0, COMMITS_PER_ROUND, commit_once,
+        (assigned, idle, ntask, qalloc, jnp.asarray(False), key),
+    )
+    return assigned, idle, ntask, qalloc, failed, refill, any_accept
+
+
+def _cand_classes(inputs) -> int:
+    """Candidate-class count of an inputs bundle (0 = dense)."""
+    if getattr(inputs, "cand_idx", None) is None:
+        return 0
+    if getattr(inputs, "task_cand", None) is None:
+        return 0
+    return int(inputs.cand_idx.shape[0])
+
+
+def solve_sparse(
+    inputs: SolverInputs,
+    max_rounds: int = 256,
+    tail_bucket: int = 3072,
+    allow_pallas: bool = True,
+) -> SolverResult:
+    """Two-phase candidate-sparsified solve.
+
+    Phase 1 ran host-side at snapshot time (solver/topk.py): a fused
+    feasibility + static-score pass over each candidate CLASS (tasks
+    sharing predicate group, req/fit rows, and private rows — gang
+    members dedup to one list) kept the top-K candidate nodes per
+    class. Phase 2 here runs the bid/commit rounds over the gathered
+    [T, K] slabs (:func:`_sparse_round`) to a fixed point, then drains
+    refill-flagged tasks (truncated slab exhausted) and any stragglers
+    through the compacted dense stage shared with :func:`solve_staged`
+    (:func:`_dense_tail`) — per-job priority order, global job-break
+    and queue-budget state, full-N fidelity on exactly the tasks that
+    need it. ``result.refills`` counts tasks that needed the refill
+    route; ``result.stages`` the dense stages that drained them.
+
+    Memory: the dense path materializes [T, N] mask/score/key
+    intermediates (~1 GB f32 at 50k×5k, ~16 GB at 200k×20k — the shape
+    this path exists to unlock); the sparse path's largest live tensors
+    are [T, K, R] gathers.
+    """
+    if isinstance(inputs, PackedInputs):
+        inputs = inputs.unpack()
+    if _cand_classes(inputs) == 0:
+        # No candidate slabs on this bundle: dense dispatch.
+        return _dense_auto(inputs, max_rounds, allow_pallas)
+    C, K = inputs.cand_idx.shape
+    T, R = inputs.task_req.shape
+    eps = inputs.eps
+
+    # Class → task expansion: per-task [K] slab tables.
+    cls = jnp.clip(inputs.task_cand, 0, C - 1)
+    cand_nodes = inputs.cand_idx[cls]                    # i32[T, K]
+    cand_static = inputs.cand_static[cls]                # f32[T, K]
+    cand_total = inputs.cand_info[0][cls]                # i32[T]
+    # Class-level Releasing escape hatch (tasks of a class share fit
+    # rows, so the per-task and per-class verdicts coincide; computed
+    # host-side from the same feas/releasing matrices solve() uses).
+    fits_releasing = inputs.cand_info[2][cls].astype(bool)
+
+    INT_MAX = jnp.iinfo(jnp.int32).max
+
+    def job_blocked(failed):
+        first_fail = jax.ops.segment_min(
+            jnp.where(failed, inputs.task_rank, INT_MAX),
+            inputs.task_job,
+            num_segments=T,
+        )
+        return inputs.task_rank > first_fail[inputs.task_job]
+
+    shared_kw = dict(
+        node_cap=inputs.node_cap, node_max_tasks=inputs.node_max_tasks,
+        queue_deserved=inputs.queue_deserved,
+        lr_weight=inputs.lr_weight, br_weight=inputs.br_weight, eps=eps,
+    )
+    head_kw = dict(
+        task_req=inputs.task_req, task_fit=inputs.task_fit,
+        task_rank=inputs.task_rank, task_queue=inputs.task_queue,
+        task_sel=inputs.task_valid,
+        task_ids=jnp.arange(T, dtype=jnp.int32),
+        cand_nodes=cand_nodes, cand_static=cand_static,
+        cand_total=cand_total,
+        fits_releasing=fits_releasing, blocked_of=job_blocked,
+        use_pallas=allow_pallas and _should_use_pallas(),
+        **shared_kw,
+    )
+
+    # ---------------- head: slab rounds to a fixed point ---------------
+    def head_body(state):
+        assigned, idle, ntask, qalloc, failed, refill, _, rnd = state
         (
-            sub_assigned, idle, ntask, qalloc, failed2, _, rounds
-        ) = lax.while_loop(tail_cond, tail_body, tstate)
-
-        placed2 = sub_assigned >= 0
-        assigned = assigned.at[idxs].set(
-            jnp.where(placed2, sub_assigned, assigned[idxs])
-        )
-        failed = failed.at[idxs].set(failed2)
-        return (
-            assigned, idle, ntask, qalloc, failed,
-            jnp.any(placed2), rounds, stages + 1,
-        )
-
-    def tail_outer_cond(ostate):
-        progressed, rounds, stages = ostate[5], ostate[6], ostate[7]
-        # Continue while the last stage placed something, tasks remain,
-        # and budgets allow. A stage that places nothing ends the solve
-        # (every remaining task is failed, blocked, over-budget, or
-        # waiting on Releasing resources).
-        assigned, qalloc, failed = ostate[0], ostate[3], ostate[4]
-        q_over = less_equal(inputs.queue_deserved, qalloc, eps)
-        remaining = jnp.any(
-            (assigned < 0) & inputs.task_valid & ~failed
-            & ~job_blocked(failed) & ~q_over[inputs.task_queue]
+            assigned, idle, ntask, qalloc, failed, refill, any_accept
+        ) = _sparse_round(
+            assigned, idle, ntask, qalloc, failed, refill, **head_kw
         )
         return (
-            progressed & remaining & (rounds < max_rounds)
-            & (stages < 64)
+            assigned, idle, ntask, qalloc, failed, refill, any_accept,
+            rnd + 1,
         )
 
-    ostate = (
-        assigned, idle, ntask, qalloc, failed,
-        jnp.array(True), rounds, jnp.array(0, jnp.int32),
+    def head_cond(state):
+        changed, rnd = state[6], state[7]
+        return changed & (rnd < max_rounds)
+
+    init = (
+        jnp.full((T,), -1, jnp.int32),
+        inputs.node_idle,
+        inputs.node_task_count,
+        inputs.queue_allocated,
+        jnp.zeros((T,), bool),
+        jnp.zeros((T,), bool),
+        jnp.array(True),
+        jnp.array(0, jnp.int32),
     )
     (
-        assigned, idle, _, qalloc, _, _, rounds, stages
-    ) = lax.while_loop(tail_outer_cond, tail_outer_body, ostate)
-    return SolverResult(assigned, idle, qalloc, rounds, stages)
+        assigned, idle, ntask, qalloc, failed, refill, _, rounds
+    ) = lax.while_loop(head_cond, head_body, init)
+    refills = jnp.sum(refill.astype(jnp.int32))
+
+    # ---------------- refill / drain: compacted dense stages -----------
+    # At the head's fixed point every still-eligible pending task is
+    # refill-flagged (a fitting candidate would have produced an accept)
+    # — the dense tail re-derives eligibility itself, so the flag only
+    # needed to stop slab re-bidding.
+    (
+        assigned, idle, _, qalloc, _, rounds, stages
+    ) = _dense_tail(
+        inputs, assigned, idle, ntask, qalloc, failed, rounds,
+        fits_releasing=fits_releasing, job_blocked=job_blocked,
+        shared_kw=shared_kw, max_rounds=max_rounds,
+        tail_bucket=tail_bucket,
+    )
+    return SolverResult(assigned, idle, qalloc, rounds, stages, refills)
 
 
 # Above this size the per-round O(T·N) compute plus O(T log T) conflict
@@ -983,16 +1316,27 @@ _STAGED_MIN_NODES = 768
 _STAGED_MIN_TASKS = 16384
 
 
-def solve_auto(inputs, max_rounds: int = 256,
-               allow_pallas: bool = True) -> SolverResult:
-    """Dispatch to the full or staged solver by (static) snapshot shape."""
-    shaped = inputs.unpack() if isinstance(inputs, PackedInputs) else inputs
+def _dense_auto(shaped, max_rounds: int, allow_pallas: bool) -> SolverResult:
+    """Shape dispatch between the full and staged DENSE solvers."""
     T = shaped.task_req.shape[0]
     N = shaped.node_idle.shape[0]
     if N >= _STAGED_MIN_NODES and T >= _STAGED_MIN_TASKS:
         return solve_staged(shaped, max_rounds=max_rounds,
                             allow_pallas=allow_pallas)
     return solve(shaped, max_rounds=max_rounds, allow_pallas=allow_pallas)
+
+
+def solve_auto(inputs, max_rounds: int = 256,
+               allow_pallas: bool = True) -> SolverResult:
+    """Dispatch by (static) snapshot shape: candidate-sparsified solve
+    when the snapshot carries candidate slabs (tensorize builds them per
+    solver/topk.topk_config — problem size policy + the KBT_SOLVER_TOPK
+    override), else the full/staged dense solver."""
+    shaped = inputs.unpack() if isinstance(inputs, PackedInputs) else inputs
+    if _cand_classes(shaped) > 0:
+        return solve_sparse(shaped, max_rounds=max_rounds,
+                            allow_pallas=allow_pallas)
+    return _dense_auto(shaped, max_rounds, allow_pallas)
 
 
 solve_jit = jax.jit(
@@ -1003,6 +1347,10 @@ solve_full_jit = jax.jit(
 )
 solve_staged_jit = jax.jit(
     solve_staged,
+    static_argnames=("max_rounds", "tail_bucket", "allow_pallas"),
+)
+solve_sparse_jit = jax.jit(
+    solve_sparse,
     static_argnames=("max_rounds", "tail_bucket", "allow_pallas"),
 )
 
@@ -1018,7 +1366,7 @@ def jit_compilation_count() -> int:
     from .device_cache import patch_jit_cache_size
 
     total = 0
-    fns = [solve_jit, solve_full_jit, solve_staged_jit]
+    fns = [solve_jit, solve_full_jit, solve_staged_jit, solve_sparse_jit]
     for ref in spmd._jitted_steps + sharding._jitted_steps:
         fn = ref()
         if fn is not None:  # dead weakref = lru-evicted step

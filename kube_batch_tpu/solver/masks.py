@@ -69,6 +69,26 @@ class CombinedMask:
             out = out & self.pair_rows[p]
         return out
 
+    def rows_for(self, task_ids: np.ndarray) -> np.ndarray:
+        """Full feasibility rows for a batch of task indices — [B, N],
+        the vectorized :meth:`row`. This is the candidate-column mask
+        the top-K selection pass (solver/topk.py) scores classes
+        against: one representative row per candidate class instead of
+        a dense [T, N] materialization."""
+        task_ids = np.asarray(task_ids, np.int64)
+        out = self.group_rows[self.task_group[task_ids]] & self.node_ok
+        P = len(self.pair_idx)
+        if P:
+            pos = np.clip(
+                np.searchsorted(self.pair_idx, task_ids), 0, P - 1
+            )
+            match = self.pair_idx[pos] == task_ids
+            if match.any():
+                out = out & np.where(
+                    match[:, None], self.pair_rows[pos], True
+                )
+        return out
+
 
 def combine_masks(masks: List, T: int, N: int) -> CombinedMask:
     """AND together BatchMasks (or legacy dense [T, N] arrays)."""

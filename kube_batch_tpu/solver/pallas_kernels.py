@@ -218,3 +218,176 @@ def pallas_bid(
     bid = bid[:T, 0]
     any_feas = any_feas[:T, 0]
     return jnp.where(any_feas, bid, N), any_feas
+
+
+def _sparse_bid_kernel(
+    pl,
+    fit_ref,      # f32[TILE_T, R]
+    req_ref,      # f32[TILE_T, R]
+    task_ok_ref,  # bool[TILE_T, 1]
+    cand_ref,     # i32[TILE_T, K] global node ids, >= N = padding
+    static_ref,   # f32[TILE_T, K] static score slab
+    idle_ref,     # f32[N, R]
+    cap_ref,      # f32[N, R]
+    cap_ok_ref,   # bool[1, N]
+    misc_ref,     # f32[1, R + 2] eps, lr_w, br_w
+    bid_ref,
+    any_ref,
+    *,
+    R: int,
+    N: int,
+    K: int,
+):
+    """Fused candidate-slab bid pass: the [TILE_T, K] analog of
+    _bid_kernel. Node tables stay whole in VMEM; the slab gathers pull
+    only the K candidate rows per task, so VMEM traffic scales with K,
+    not N. Semantics mirror kernels._sparse_round's jnp chain exactly
+    (tests assert bit-equality in interpret mode)."""
+    idle = idle_ref[:]                                    # [N, R]
+    cap = cap_ref[:]
+    nidx = cand_ref[:]                                    # i32[TILE_T, K]
+    valid = nidx < N
+    safe = jnp.minimum(nidx, N - 1)
+    flat = safe.reshape(-1)
+
+    fits = jnp.ones((TILE_T, K), dtype=jnp.bool_)
+    for d in range(R):
+        eps_d = misc_ref[0, d]
+        idle_d = jnp.take(idle[:, d], flat, axis=0).reshape(TILE_T, K)
+        fits = fits & (fit_ref[:, d][:, None] - idle_d < eps_d)
+
+    cap_ok = jnp.take(
+        cap_ok_ref[0, :], flat, axis=0
+    ).reshape(TILE_T, K)
+    mask = fits & valid & cap_ok & task_ok_ref[:, 0][:, None]
+
+    lr_w = misc_ref[0, R]
+    br_w = misc_ref[0, R + 1]
+    idle_cpu = jnp.take(idle[:, 0], flat, axis=0).reshape(TILE_T, K)
+    idle_mem = jnp.take(idle[:, 1], flat, axis=0).reshape(TILE_T, K)
+    cap_cpu = jnp.take(cap[:, 0], flat, axis=0).reshape(TILE_T, K)
+    cap_mem = jnp.take(cap[:, 1], flat, axis=0).reshape(TILE_T, K)
+    rem_cpu = idle_cpu - req_ref[:, 0][:, None]
+    rem_mem = idle_mem - req_ref[:, 1][:, None]
+    safe_cpu = jnp.where(cap_cpu > 0, cap_cpu, 1.0)
+    safe_mem = jnp.where(cap_mem > 0, cap_mem, 1.0)
+    lr = 0.5 * (
+        jnp.where(
+            cap_cpu > 0,
+            jnp.maximum(rem_cpu, 0.0) * MAX_PRIORITY / safe_cpu,
+            0.0,
+        )
+        + jnp.where(
+            cap_mem > 0,
+            jnp.maximum(rem_mem, 0.0) * MAX_PRIORITY / safe_mem,
+            0.0,
+        )
+    )
+    frac_cpu = jnp.where(cap_cpu > 0, 1.0 - rem_cpu / safe_cpu, 1.0)
+    frac_mem = jnp.where(cap_mem > 0, 1.0 - rem_mem / safe_mem, 1.0)
+    br = jnp.where(
+        (frac_cpu >= 1.0) | (frac_mem >= 1.0),
+        0.0,
+        MAX_PRIORITY - jnp.abs(frac_cpu - frac_mem) * MAX_PRIORITY,
+    )
+    score = lr_w * lr + br_w * br + static_ref[:]
+
+    # Integer bid keys with GLOBAL task/node ids (kernels.bid_keys):
+    # identical hash bits to the dense chain, so sparse and dense paths
+    # tie-break the same node the same way.
+    t_ids = (
+        pl.program_id(0) * TILE_T
+        + jax.lax.broadcasted_iota(jnp.int32, (TILE_T, K), 0)
+    ).astype(jnp.uint32)
+    n_ids = nidx.astype(jnp.uint32)
+    x = t_ids * jnp.uint32(2654435761) ^ (n_ids * jnp.uint32(0x9E3779B9))
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(2246822519)
+    h = ((x >> 8) & jnp.uint32((1 << _KEY_HASH_BITS) - 1)).astype(jnp.int32)
+    q = jnp.clip(
+        jnp.round(score / SCORE_QUANTUM) + _KEY_BIAS, 0, (1 << 20) - 1
+    ).astype(jnp.int32)
+    key = jnp.where(mask, (q << _KEY_HASH_BITS) | h, -1)
+
+    # Row max, then the lowest GLOBAL node id achieving it: candidate
+    # slots ascend by node id, so this equals argmax's first-slot rule.
+    row_max = jnp.max(key, axis=1)
+    is_max = (key == row_max[:, None]) & mask
+    bid_ref[:] = jnp.min(
+        jnp.where(is_max, nidx, N), axis=1
+    ).astype(jnp.int32)[:, None]
+    any_ref[:] = jnp.any(mask, axis=1)[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_bid_sparse(
+    task_fit,     # f32[T, R]
+    task_req,     # f32[T, R]
+    task_ok,      # bool[T]
+    cand_nodes,   # i32[T, K] global node ids (>= N = padding)
+    cand_static,  # f32[T, K]
+    idle,         # f32[N, R]
+    cap,          # f32[N, R]
+    cap_ok,       # bool[N]
+    eps,          # f32[R]
+    lr_weight,    # f32[]
+    br_weight,    # f32[]
+    interpret: bool = False,
+):
+    """Fused slab mask+score+key+argmax; returns (bid i32[T] — GLOBAL
+    node id or N for no feasible candidate, any_feas bool[T]). The task
+    axis pads to TILE_T internally like :func:`pallas_bid`."""
+    T, R = task_fit.shape
+    N = idle.shape[0]
+    K = cand_nodes.shape[1]
+    pad = (-T) % TILE_T
+    if pad:
+        task_fit = jnp.pad(task_fit, ((0, pad), (0, 0)))
+        task_req = jnp.pad(task_req, ((0, pad), (0, 0)))
+        task_ok = jnp.pad(task_ok, (0, pad))
+        cand_nodes = jnp.pad(
+            cand_nodes, ((0, pad), (0, 0)), constant_values=N
+        )
+        cand_static = jnp.pad(cand_static, ((0, pad), (0, 0)))
+    Tp = T + pad
+    misc = jnp.concatenate(
+        [eps, lr_weight[None], br_weight[None]]
+    ).astype(jnp.float32)[None, :]
+
+    pl = _pl()
+    grid = (Tp // TILE_T,)
+    kernel = functools.partial(
+        _sparse_bid_kernel, pl, R=R, N=N, K=K
+    )
+    in_specs = [
+        pl.BlockSpec((TILE_T, R), lambda i: (i, 0)),
+        pl.BlockSpec((TILE_T, R), lambda i: (i, 0)),
+        pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
+        pl.BlockSpec((TILE_T, K), lambda i: (i, 0)),
+        pl.BlockSpec((TILE_T, K), lambda i: (i, 0)),
+        pl.BlockSpec((N, R), lambda i: (0, 0)),
+        pl.BlockSpec((N, R), lambda i: (0, 0)),
+        pl.BlockSpec((1, N), lambda i: (0, 0)),
+        pl.BlockSpec((1, R + 2), lambda i: (0, 0)),
+    ]
+    bid, any_feas = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_T, 1), lambda i: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((Tp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((Tp, 1), jnp.bool_),
+        ),
+        interpret=interpret,
+    )(
+        task_fit, task_req, task_ok[:, None], cand_nodes,
+        cand_static.astype(jnp.float32), idle, cap, cap_ok[None, :],
+        misc,
+    )
+    bid = bid[:T, 0]
+    any_feas = any_feas[:T, 0]
+    return jnp.where(any_feas, bid, N), any_feas

@@ -100,13 +100,25 @@ def shardings_for(inputs, mesh: Mesh):
     major = NamedSharding(mesh, P(NODE_AXIS))
     minor = NamedSharding(mesh, P(None, NODE_AXIS))
     cls = type(inputs)
+
+    def spec(f, sh):
+        # Optional fields (candidate slabs on legacy bundles) may be
+        # None; the sharding pytree must mirror that or device_put's
+        # treedefs mismatch. Candidate slabs are class-row tables (node
+        # IDS, not node columns), so they replicate.
+        return None if getattr(inputs, f, None) is None else sh
+
     if isinstance(inputs, PackedInputs):
         return cls(**{
-            f: minor if f in _PACKED_NODE_MINOR else rep
+            f: spec(f, minor if f in _PACKED_NODE_MINOR else rep)
             for f in cls._fields
         })
     return cls(**{
-        f: major if f in _NODE_MAJOR else minor if f in _NODE_MINOR else rep
+        f: spec(
+            f,
+            major if f in _NODE_MAJOR
+            else minor if f in _NODE_MINOR else rep,
+        )
         for f in cls._fields
     })
 
@@ -240,9 +252,23 @@ def solve_sharded(
     the program. ``impl`` selects the hierarchical shard_map solver
     (default) or the legacy GSPMD auto-partitioning (see
     :func:`sharded_step`).
+
+    Candidate-sparsified inputs (topk slabs present) always take the
+    single-device sparse jit, mesh or not: the slab rounds do O(T·K)
+    work and materialize no [T, N] structures, so one device running
+    the sparse program beats N/s-sharded dense rounds whenever
+    K·s < N (the production regime), while candidate gathers inside
+    shard_map would force per-round cross-shard node-row collectives.
+    The sharded SPMD solvers remain the dense scale path.
     """
     if mesh is None:
         mesh = default_mesh()
+    if mesh is not None and staged is None:
+        # Shape probe only — no unpack() (its eager per-field slices
+        # cost real milliseconds outside a jit).
+        cand = getattr(inputs, "cand_idx", None)
+        if cand is not None and cand.shape[0] > 0:
+            mesh = None
     if mesh is None:
         # Single device: reuse the module-level cached jits.
         from .kernels import solve_full_jit, solve_jit, solve_staged_jit

@@ -742,6 +742,38 @@ def tensorize(
     # Tie-breaking happens in-kernel via hashed integer bid keys
     # (kernels.bid_keys); nothing to materialize host-side.
 
+    weights = ssn.solver_dynamic_weights()
+    lr_w = float(weights.get("leastrequested", 0.0))
+    br_w = float(weights.get("balancedresource", 0.0))
+
+    # --- top-K candidate selection (solver/topk.py) -----------------------
+    # Phase 1 of the sparse solve: dedup tasks into candidate classes
+    # and keep each class's top-K nodes by the fused feasibility +
+    # initial-idle score pass. Runs on the UNPADDED arrays; the slabs
+    # are padded/bucketed below with everything else.
+    from .topk import select_candidates, topk_config
+
+    tk = topk_config(T, N)
+    cand_sel = None
+    sparse_reason = tk.reason
+    if tk.enabled:
+        cand_sel = select_candidates(
+            mask, score_rows_map, task_req, task_fit,
+            node_idle, node_cap, node_releasing,
+            node_task_count, node_max_tasks,
+            layout.eps(), lr_w, br_w, tk.k,
+        )
+        if cand_sel is None:
+            sparse_reason = "class-budget"
+    sparse_stats = {
+        "enabled": cand_sel is not None,
+        "k": tk.k,
+        "reason": sparse_reason,
+    }
+    if cand_sel is not None:
+        sparse_stats.update(cand_sel.stats)
+    last_tensorize_stats["sparse"] = sparse_stats
+
     # --- queue budget vectors ---------------------------------------------
     Qn = max(1, len(queue_order))
     queue_deserved = np.full((Qn, R), np.inf, dtype=np.float32)
@@ -808,9 +840,25 @@ def tensorize(
         score_idx[k] = i
         score_rows[k, :N] = score_rows_map[i]
 
-    weights = ssn.solver_dynamic_weights()
-    lr_w = float(weights.get("leastrequested", 0.0))
-    br_w = float(weights.get("balancedresource", 0.0))
+    # Candidate slabs: class axis pow2-bucketed like pair/score rows;
+    # the invalid-node sentinel moves from N (selection-time) to the
+    # PADDED node count so the kernel's single `cand < N` check covers
+    # selection padding, class padding, and node padding alike.
+    if cand_sel is not None:
+        task_cand = pad_rows(cand_sel.task_cand, Tp)
+        cand_idx = cand_sel.cand_idx
+        cand_idx[cand_idx >= N] = Np
+        Cn = cand_idx.shape[0]
+        Cp = _pow2(Cn) if pad else Cn
+        cand_idx = pad_rows(cand_idx, Cp, fill=Np)
+        cand_static = pad_rows(cand_sel.cand_static, Cp)
+        cand_info = np.zeros((3, Cp), dtype=np.int32)
+        cand_info[:, :Cn] = cand_sel.cand_info
+    else:
+        task_cand = np.zeros(Tp, dtype=np.int32)
+        cand_idx = np.zeros((0, 1), dtype=np.int32)
+        cand_static = np.zeros((0, 1), dtype=np.float32)
+        cand_info = np.zeros((3, 0), dtype=np.int32)
 
     # NumPy-backed SolverInputs: what the native CPU solver consumes, and
     # the source arrays for the device pack below.
@@ -838,6 +886,10 @@ def tensorize(
         eps=layout.eps(),
         lr_weight=np.float32(lr_w),
         br_weight=np.float32(br_w),
+        task_cand=task_cand,
+        cand_idx=cand_idx,
+        cand_static=cand_static,
+        cand_info=cand_info,
     )
     ctx = SnapshotContext(
         layout, tasks, nodes, queue_order, mask,
@@ -863,7 +915,7 @@ def tensorize(
         "task_f32": np.stack([task_req, task_fit]),
         "task_i32": np.stack([
             task_rank, task_queue, task_job, task_group,
-            task_valid.astype(np.int32),
+            task_valid.astype(np.int32), task_cand,
         ]),
         "node_f32": np.stack([node_idle, node_releasing, node_cap]),
         "node_i32": np.stack([
@@ -878,6 +930,9 @@ def tensorize(
         "misc": np.concatenate(
             [layout.eps(), [lr_w, br_w]]
         ).astype(np.float32),
+        "cand_idx": cand_idx,
+        "cand_static": cand_static,
+        "cand_info": cand_info,
     }
     from .device_cache import device_cache_of
 

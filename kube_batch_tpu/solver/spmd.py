@@ -104,15 +104,27 @@ def spmd_shardings_for(inputs, mesh: Mesh):
 
     rep = NamedSharding(mesh, P())
     cls = type(inputs)
+
+    def spec(f, sh):
+        # None-able candidate-slab fields mirror as None so device_put
+        # treedefs match (slabs replicate: they carry node IDS, and the
+        # sharded solvers run the dense path regardless — see
+        # solve_sharded's sparse note).
+        return None if getattr(inputs, f, None) is None else sh
+
     if isinstance(inputs, PackedInputs):
         minor = NamedSharding(mesh, P(None, NODE_AXIS))
         sharded = {"group_feas", "pair_feas", "score_rows"}
         return cls(**{
-            f: minor if f in sharded else rep for f in cls._fields
+            f: spec(f, minor if f in sharded else rep)
+            for f in cls._fields
         })
     return cls(**{
-        f: NamedSharding(mesh, _SHARDED_SPECS[f])
-        if f in _SHARDED_SPECS else rep
+        f: spec(
+            f,
+            NamedSharding(mesh, _SHARDED_SPECS[f])
+            if f in _SHARDED_SPECS else rep,
+        )
         for f in cls._fields
     })
 
@@ -651,8 +663,16 @@ def _spmd_step(mesh: Mesh, staged, max_rounds, tail_bucket):
     def run(inputs):
         if isinstance(inputs, PackedInputs):
             inputs = inputs.unpack()  # inside jit: free slicing
+        # None-able candidate-slab fields mirror as None (treedef
+        # match); present slabs replicate but are IGNORED here — the
+        # sharded solvers keep the dense rounds (candidate gathers
+        # would force cross-shard node-row collectives per round).
         in_specs = SolverInputs(**{
-            f: _SHARDED_SPECS.get(f, P()) for f in SolverInputs._fields
+            f: (
+                None if getattr(inputs, f, None) is None
+                else _SHARDED_SPECS.get(f, P())
+            )
+            for f in SolverInputs._fields
         })
         fn = shard_map(
             functools.partial(

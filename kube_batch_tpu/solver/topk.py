@@ -1,0 +1,304 @@
+"""Phase 1 of the candidate-sparsified solve: top-K node selection.
+
+At 50k tasks x 5k nodes every dense solver structure is [T, N] — a f32
+score matrix alone is ~1 GB — which caps scale far short of the 200k x
+20k shapes the roadmap targets (~16 GB, infeasible). But the bid/commit
+dynamics only ever LAND a task on one of a handful of best-scoring
+feasible nodes (Tesserae's placement policies, PAPERS.md: candidate sets
+of a few dozen nodes preserve placement quality; CvxCluster gets its
+100-1000x from exactly this granularity structure). So one cheap fused
+pass here — host-side NumPy, at snapshot time — scores every candidate
+CLASS against the snapshot's initial idle state and keeps its top-K
+candidate nodes; the solver's rounds then run on gathered [T, K] slabs
+(kernels._sparse_round / native greedy_allocate_sparse).
+
+A candidate CLASS dedups tasks that provably share a score surface:
+same predicate feasibility group, same req/fit rows, and no private
+pair/score rows (tasks WITH private rows become singleton classes that
+keep their rows). Gang members instantiated from one pod template all
+land in one class, so selection work scales with the number of DISTINCT
+task shapes (dozens to hundreds), not tasks.
+
+Selection eligibility is ``feasible AND fits-at-initial-idle AND
+pod-count-capacity-open``: idle only shrinks and pod counts only grow
+during a solve, so a node outside that set can NEVER accept the class's
+tasks — which yields the solver's exactness invariant: a class whose
+eligible set has <= K nodes gets a COMPLETE slab (``cand_info[0]``,
+the refill gauge), and slab exhaustion for it is bit-identical to the
+dense solver's no-fit verdict. Truncated classes route exhausted tasks
+to the refill stage instead (kernels._dense_tail), never to a false
+job break.
+
+``KBT_SOLVER_TOPK`` overrides the policy: an integer forces that K at
+any problem size; ``0``/``off``/``dense`` disables sparsification.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from .kernels import (
+    _KEY_BIAS,
+    _KEY_HASH_BITS,
+    CPU_DIM,
+    MAX_PRIORITY,
+    MEM_DIM,
+    SCORE_QUANTUM,
+)
+
+# Sparsification pays off once the dense [T, N] structures dominate and
+# the slab is a real subset; below these the dense solvers win outright.
+_SPARSE_MIN_TASKS = 8192
+_SPARSE_MIN_NODES = 1024
+DEFAULT_K = 64
+
+# Selection itself costs O(C * N); if class dedup degenerates (every
+# task a distinct shape) that approaches the dense pass it is meant to
+# replace, so the policy falls back to dense past this budget.
+_CLASS_BUDGET_FACTOR = 4
+
+
+@dataclass(frozen=True)
+class TopKConfig:
+    """Resolved candidate-sparsification policy for one snapshot."""
+
+    k: int
+    enabled: bool
+    reason: str
+
+
+def _pow2(n: int) -> int:
+    if n <= 0:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def topk_config(n_tasks: int, n_nodes: int) -> TopKConfig:
+    """Resolve K and the sparse on/off decision for a (T, N) snapshot.
+
+    K is power-of-two bucketed (like the task-axis shape buckets) so a
+    configured K never mints per-value jit variants."""
+    raw = os.environ.get("KBT_SOLVER_TOPK", "").strip().lower()
+    if raw in ("0", "off", "dense", "disable", "disabled", "false"):
+        return TopKConfig(0, False, "env-disabled")
+    k = DEFAULT_K
+    forced = False
+    if raw:
+        try:
+            k = max(1, int(raw))
+            forced = True
+        except ValueError:
+            pass
+    k = _pow2(k)
+    if forced:
+        return TopKConfig(k, True, "env-forced")
+    if n_tasks < _SPARSE_MIN_TASKS or n_nodes < _SPARSE_MIN_NODES:
+        return TopKConfig(k, False, "small-problem")
+    if 4 * k >= n_nodes:
+        return TopKConfig(k, False, "k-covers-nodes")
+    return TopKConfig(k, True, "size-policy")
+
+
+@dataclass
+class CandidateSet:
+    """Selection output, pre-padding (node sentinel = N unpadded)."""
+
+    task_cand: np.ndarray    # i32[T] class id per task
+    cand_idx: np.ndarray     # i32[C, K] candidate node ids ascending
+    cand_static: np.ndarray  # f32[C, K] static score slab
+    cand_info: np.ndarray    # i32[3, C] total / any_feas / fits_releasing
+    stats: dict
+
+
+def _sel_hash(c_ids: np.ndarray, n_ids: np.ndarray) -> np.ndarray:
+    """Decorrelated per-(class, node) hash in [0, 1024) — the selection
+    analog of kernels._bid_hash. Spreads equal-scored classes across
+    DIFFERENT slabs so a homogeneous cluster does not herd every class
+    onto the same K nodes (the selection-level form of the bid-key
+    tie-break rationale)."""
+    x = (c_ids.astype(np.uint32) * np.uint32(2654435761)) ^ (
+        n_ids.astype(np.uint32) * np.uint32(0x9E3779B9)
+    )
+    x = x ^ (x >> np.uint32(13))
+    x = x * np.uint32(2246822519)
+    return (
+        (x >> np.uint32(8)) & np.uint32((1 << _KEY_HASH_BITS) - 1)
+    ).astype(np.int64)
+
+
+def _dyn_score_np(req, idle, cap, lr_w, br_w):
+    """[C, N] LeastRequested + Balanced in f32 NumPy — the selection
+    mirror of kernels._dyn_score_core (selection quality only; kernel
+    rounds rescore against evolving idle on-device)."""
+    dims = (CPU_DIM, MEM_DIM)
+    req2 = req[:, None, :][..., dims].astype(np.float32)     # [C, 1, 2]
+    idle2 = idle[None, :, :][..., dims].astype(np.float32)   # [1, N, 2]
+    cap2 = cap[None, :, :][..., dims].astype(np.float32)
+    safe_cap = np.where(cap2 > 0, cap2, np.float32(1.0))
+    remaining = idle2 - req2
+    lr = np.where(
+        cap2 > 0,
+        np.maximum(remaining, 0.0) * np.float32(MAX_PRIORITY) / safe_cap,
+        np.float32(0.0),
+    )
+    lr_score = lr.mean(axis=-1)
+    frac = np.where(cap2 > 0, 1.0 - remaining / safe_cap, np.float32(1.0))
+    diff = np.abs(frac[..., 0] - frac[..., 1])
+    br_score = np.where(
+        (frac >= 1.0).any(axis=-1),
+        np.float32(0.0),
+        np.float32(MAX_PRIORITY) - diff * np.float32(MAX_PRIORITY),
+    )
+    return (
+        np.float32(lr_w) * lr_score + np.float32(br_w) * br_score
+    ).astype(np.float32)
+
+
+def select_candidates(
+    mask,                         # masks.CombinedMask (unpadded)
+    score_rows_map: Dict[int, np.ndarray],
+    task_req: np.ndarray,         # f32[T, R] rank-ordered
+    task_fit: np.ndarray,         # f32[T, R]
+    node_idle: np.ndarray,        # [N, R]
+    node_cap: np.ndarray,         # [N, R]
+    node_releasing: np.ndarray,   # [N, R]
+    node_task_count: np.ndarray,  # i32[N]
+    node_max_tasks: np.ndarray,   # i32[N]
+    eps: np.ndarray,              # [R]
+    lr_weight: float,
+    br_weight: float,
+    k: int,
+) -> Optional[CandidateSet]:
+    """Run the fused feasibility + static-score selection pass.
+
+    Returns None (→ dense solve, with the reason in the caller's stats)
+    when class dedup degenerates past the selection budget."""
+    T, R = task_req.shape
+    N = node_idle.shape[0]
+    k = min(_pow2(k), _pow2(N))
+
+    # ---- class dedup: (feasibility group, private-row id, req, fit) ----
+    priv = np.full(T, -1, np.int64)
+    if len(mask.pair_idx):
+        priv[mask.pair_idx] = mask.pair_idx
+    if score_rows_map:
+        for i in score_rows_map:
+            priv[int(i)] = int(i)
+    # Exact float32 keys: group/priv ids stay < 2^24 (tasks per snapshot
+    # are far below that), req/fit are already f32 rows.
+    key_mat = np.column_stack([
+        mask.task_group.astype(np.float32),
+        priv.astype(np.float32),
+        task_req.astype(np.float32),
+        task_fit.astype(np.float32),
+    ])
+    _, rep_idx, task_cand = np.unique(
+        key_mat, axis=0, return_index=True, return_inverse=True
+    )
+    task_cand = task_cand.reshape(-1).astype(np.int32)
+    rep_idx = rep_idx.astype(np.int64)
+    C = len(rep_idx)
+    if C * N > max(_CLASS_BUDGET_FACTOR * T * k, 1 << 22):
+        return None
+
+    idle32 = np.ascontiguousarray(node_idle, np.float32)
+    cap32 = np.ascontiguousarray(node_cap, np.float32)
+    eps32 = np.asarray(eps, np.float32)
+    cap_ok0 = (node_max_tasks == 0) | (node_task_count < node_max_tasks)
+    has_releasing = bool(np.asarray(node_releasing).any())
+    rel32 = (
+        np.ascontiguousarray(node_releasing, np.float32)
+        if has_releasing else None
+    )
+    rep_fit = task_fit[rep_idx].astype(np.float32)
+    rep_req = task_req[rep_idx].astype(np.float32)
+    rep_priv = priv[rep_idx]
+
+    cand_idx = np.full((C, k), N, np.int32)
+    cand_static = np.zeros((C, k), np.float32)
+    cand_info = np.zeros((3, C), np.int32)
+
+    node_ids = np.arange(N, dtype=np.int64)
+    chunk = max(1, min(C, (1 << 22) // max(N, 1)))
+    for c0 in range(0, C, chunk):
+        c1 = min(c0 + chunk, C)
+        rows = c1 - c0
+        feas = mask.rows_for(rep_idx[c0:c1])                 # [rows, N]
+        fit_ok = np.all(
+            rep_fit[c0:c1][:, None, :] - idle32[None, :, :] < eps32,
+            axis=-1,
+        )
+        elig = feas & fit_ok & cap_ok0[None, :]
+        cand_info[0, c0:c1] = np.minimum(
+            elig.sum(axis=1), np.iinfo(np.int32).max
+        )
+        cand_info[1, c0:c1] = (feas & cap_ok0[None, :]).any(axis=1)
+        if has_releasing:
+            rel_ok = np.all(
+                rep_fit[c0:c1][:, None, :] - rel32[None, :, :] < eps32,
+                axis=-1,
+            )
+            cand_info[2, c0:c1] = (rel_ok & feas).any(axis=1)
+
+        score = _dyn_score_np(
+            rep_req[c0:c1], idle32, cap32, lr_weight, br_weight
+        )
+        # Singleton classes keep their private static score rows — the
+        # slab ships the gathered values so the kernel adds them exactly
+        # like the dense `dynamic + static` chain.
+        srows = {}
+        for local in range(rows):
+            p = int(rep_priv[c0 + local])
+            if p >= 0 and p in score_rows_map:
+                srow = np.asarray(score_rows_map[p], np.float32)
+                score[local] += srow
+                srows[local] = srow
+
+        # Integer selection keys: quantized score in the high bits, the
+        # class/node hash in the low bits — kernels.bid_keys' exact
+        # format (shared constants), so selection ordering tracks bid
+        # ordering if the key layout is ever retuned.
+        q = np.clip(
+            np.round(score / np.float32(SCORE_QUANTUM)).astype(np.int64)
+            + _KEY_BIAS,
+            0, (1 << 20) - 1,
+        )
+        skey = (q << _KEY_HASH_BITS) | _sel_hash(
+            np.arange(c0, c1, dtype=np.int64)[:, None],
+            node_ids[None, :],
+        )
+        skey = np.where(elig, skey, -1)
+
+        if k < N:
+            part = np.argpartition(skey, N - k, axis=1)[:, N - k:]
+        else:
+            part = np.broadcast_to(node_ids[None, :], (rows, N)).copy()
+        pkey = np.take_along_axis(skey, part, axis=1)
+        part = part.astype(np.int32)
+        part[pkey < 0] = N           # ineligible picks → sentinel
+        part.sort(axis=1)            # ascending node id, sentinels last
+        cand_idx[c0:c1, : part.shape[1]] = part[:, :k]
+        for local, srow in srows.items():
+            row = cand_idx[c0 + local]
+            sel = row < N
+            cand_static[c0 + local, sel] = srow[row[sel]]
+
+    slab_bytes = (
+        cand_idx.nbytes + cand_static.nbytes + cand_info.nbytes
+        + task_cand.nbytes
+    )
+    stats = {
+        "classes": int(C),
+        "k": int(k),
+        "slab_bytes": int(slab_bytes),
+        # What the dense path would materialize per round on device:
+        # the [T, N] bool mask and f32 score/key matrices.
+        "dense_mask_bytes": int(T) * int(N),
+        "dense_score_bytes": int(T) * int(N) * 4,
+        "truncated_classes": int((cand_info[0] > k).sum()),
+    }
+    return CandidateSet(task_cand, cand_idx, cand_static, cand_info, stats)

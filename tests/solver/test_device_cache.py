@@ -15,7 +15,7 @@ import pytest
 
 import kube_batch_tpu.actions  # noqa: F401 (registers actions)
 import kube_batch_tpu.plugins  # noqa: F401 (registers plugins)
-from kube_batch_tpu.api import PodPhase, TaskStatus, build_resource_list
+from kube_batch_tpu.api import PodPhase, build_resource_list
 from kube_batch_tpu.framework import close_session, open_session
 from kube_batch_tpu.solver import PackedInputs, solve_jit, tensorize
 from kube_batch_tpu.solver.device_cache import last_pack_stats

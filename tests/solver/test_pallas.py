@@ -7,8 +7,19 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from kube_batch_tpu.solver.kernels import bid_keys, dynamic_scores, less_equal
-from kube_batch_tpu.solver.pallas_kernels import TILE_T, pallas_bid
+from kube_batch_tpu.solver.kernels import (
+    CPU_DIM,
+    MEM_DIM,
+    _dyn_score_core,
+    bid_keys,
+    dynamic_scores,
+    less_equal,
+)
+from kube_batch_tpu.solver.pallas_kernels import (
+    TILE_T,
+    pallas_bid,
+    pallas_bid_sparse,
+)
 
 try:  # pallas import may be unavailable under the purged CPU harness
     from jax.experimental import pallas as _pl  # noqa: F401
@@ -118,6 +129,78 @@ def test_pallas_bid_with_static_score_rows():
         )
         np.testing.assert_array_equal(np.asarray(any_p), np.asarray(any_j))
         np.testing.assert_array_equal(np.asarray(bid_p), np.asarray(bid_j))
+
+
+def jnp_reference_sparse_bid(task_fit, task_req, task_ok, cand_nodes,
+                             cand_static, idle, cap, cap_ok, eps,
+                             lr_w, br_w):
+    """The jnp slab chain from kernels._sparse_round — the reference
+    semantics pallas_bid_sparse must reproduce bit-for-bit."""
+    T = task_fit.shape[0]
+    N = idle.shape[0]
+    valid = cand_nodes < N
+    safe = jnp.minimum(cand_nodes, N - 1)
+    idle_slab = idle[safe]
+    fits = less_equal(task_fit[:, None, :], idle_slab, eps)
+    mask = fits & valid & cap_ok[safe] & task_ok[:, None]
+    dims = (CPU_DIM, MEM_DIM)
+    score = _dyn_score_core(
+        task_req[:, None, dims], idle_slab[..., dims],
+        cap[safe][..., dims], lr_w, br_w,
+    ) + cand_static
+    key = bid_keys(
+        score, jnp.arange(T, dtype=jnp.int32)[:, None], cand_nodes
+    )
+    key = jnp.where(mask, key, -1)
+    any_feas = jnp.any(mask, axis=1)
+    col = jnp.argmax(key, axis=1)
+    bid = cand_nodes[jnp.arange(T), col]
+    return jnp.where(any_feas, bid, N), any_feas
+
+
+def _sparse_case(seed, T, N, K, R=3):
+    case = _random_case(seed, T, N, R)
+    rng = np.random.RandomState(seed + 1000)
+    cand = np.argsort(rng.rand(T, N), axis=1)[:, :K].astype(np.int32)
+    cand[rng.rand(T, K) < 0.15] = N  # padding sentinels
+    cand.sort(axis=1)                # ascending, sentinels last
+    case["cand_nodes"] = jnp.asarray(cand)
+    case["cand_static"] = jnp.asarray(
+        rng.uniform(0, 5, (T, K)).astype(np.float32)
+    )
+    del case["feas"]
+    return case
+
+
+def test_pallas_sparse_bid_matches_jnp_chain():
+    for seed, K in ((0, 8), (1, 16), (2, 4)):
+        case = _sparse_case(seed, T=2 * TILE_T, N=256, K=K)
+        args = (
+            case["task_fit"], case["task_req"], case["task_ok"],
+            case["cand_nodes"], case["cand_static"], case["idle"],
+            case["cap"], case["cap_ok"], case["eps"], case["lr_w"],
+            case["br_w"],
+        )
+        bid_p, any_p = pallas_bid_sparse(*args, interpret=True)
+        bid_j, any_j = jnp_reference_sparse_bid(*args)
+        np.testing.assert_array_equal(np.asarray(any_p), np.asarray(any_j))
+        np.testing.assert_array_equal(np.asarray(bid_p), np.asarray(bid_j))
+
+
+def test_pallas_sparse_bid_all_padded_row():
+    # A task whose slab is all sentinels must report no feasible bid.
+    case = _sparse_case(5, T=TILE_T, N=128, K=8)
+    cand = np.asarray(case["cand_nodes"]).copy()
+    cand[0] = 128
+    case["cand_nodes"] = jnp.asarray(cand)
+    bid_p, any_p = pallas_bid_sparse(
+        case["task_fit"], case["task_req"], case["task_ok"],
+        case["cand_nodes"], case["cand_static"], case["idle"],
+        case["cap"], case["cap_ok"], case["eps"], case["lr_w"],
+        case["br_w"], interpret=True,
+    )
+    assert not bool(np.asarray(any_p)[0])
+    assert int(np.asarray(bid_p)[0]) == 128
 
 
 def test_pallas_bid_unaligned_task_axis():

@@ -14,7 +14,6 @@ Three contracts pinned here:
 """
 
 import numpy as np
-import pytest
 
 import kube_batch_tpu.actions  # noqa: F401 (registers actions)
 import kube_batch_tpu.plugins  # noqa: F401 (registers plugins)
