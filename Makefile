@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke verify ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke verify ci image clean
 
 all: native
 
@@ -49,6 +49,16 @@ bench:
 bench-smoke:
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 KBT_SOLVER_TOPK=8 $(PY) bench.py --smoke
 
+# Deterministic-simulator smoke: a short seeded fault run (bind
+# failures + node flaps + an injected cycle crash) through the REAL
+# scheduler/cache/actions stack; the CLI exits nonzero on ANY invariant
+# violation (oversubscription, split gang, lost/double-bound task,
+# fair-share breach). doc/design/simulator.md.
+sim-smoke:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim --cycles 120 --seed 7 \
+		--faults "bind:0.05,node-flap:0.02,crash:0.02" \
+		--node-churn 0.03 --quiet
+
 # Static checks (reference verify: gofmt/goimports/golint,
 # Makefile:13-17): byte-compile + the AST lint (unused/duplicate
 # imports, star imports, syntax).
@@ -62,7 +72,7 @@ verify:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify native test bench-smoke
+ci: verify native test bench-smoke sim-smoke
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

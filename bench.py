@@ -635,6 +635,49 @@ def bench_sparse_scale(shape="200000x20000", seed=0):
     return out
 
 
+def bench_sim(cycles=80, seed=11):
+    """Deterministic-simulator throughput: seeded fault run through the
+    full production cycle (virtual clock, so the measured time is pure
+    scheduling+churn work), once with the invariant checker and once
+    without — the checker's overhead must stay a small fraction of the
+    cycle or long-horizon CI runs get expensive."""
+    from kube_batch_tpu.native import native_available
+    from kube_batch_tpu.sim import SimConfig, WorkloadSpec
+    from kube_batch_tpu.sim.harness import run_sim
+
+    backend = "native" if native_available() else "auto"
+
+    def one(check):
+        report, _ = run_sim(SimConfig(
+            cycles=cycles,
+            seed=seed,
+            faults="bind:0.05,node-flap:0.02",
+            workload=WorkloadSpec(nodes=12),
+            backend=backend,
+            check_invariants=check,
+        ))
+        return report
+
+    checked = one(True)
+    unchecked = one(False)
+    out = {
+        "cycles": cycles,
+        "backend": backend,
+        "placements": checked.placements,
+        "violations": len(checked.violations),
+        "cycles_per_sec": round(checked.cycles_per_sec, 1),
+        "cycles_per_sec_nocheck": round(unchecked.cycles_per_sec, 1),
+        "invariant_check_ms_per_cycle": round(
+            checked.check_seconds / cycles * 1e3, 3
+        ),
+        "invariant_check_overhead_pct": round(
+            100.0 * checked.check_seconds
+            / max(checked.wall_seconds, 1e-9), 1
+        ),
+    }
+    return out
+
+
 def run_smoke():
     """``bench.py --smoke`` (the `make bench-smoke` target): small
     shapes through the full production cycle with the sparse solver
@@ -832,6 +875,13 @@ def main():
         except Exception as exc:  # pragma: no cover - defensive
             sparse_scale = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # Long-horizon simulator throughput + invariant-checker overhead
+    # (guarded like the other sections).
+    try:
+        sim = bench_sim()
+    except Exception as exc:  # pragma: no cover - defensive
+        sim = {"error": f"{type(exc).__name__}: {exc}"}
+
     dev0 = jax.devices()[0]
     provenance = {
         "platform": str(dev0.platform),
@@ -858,6 +908,7 @@ def main():
         "cycle": cycle,
         "device_cache": device_cache,
         "solver_sparse": tpu["sparse"],
+        "sim": sim,
         **({"sparse_scale": sparse_scale} if sparse_scale else {}),
         **extra,
     }))

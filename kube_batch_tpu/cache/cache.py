@@ -294,9 +294,14 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     "failed to handle %s %s event in cache", kind, event_type
                 )
 
-    def run(self, stop_event: Optional[threading.Event] = None) -> None:
-        """Start ingest + resync/cleanup loops (reference cache.go:355-377)."""
-        self._stop = stop_event or threading.Event()
+    def start_ingest(self) -> None:
+        """Attach the cluster watch and replay the initial object list
+        (the informer-start half of :meth:`run`), WITHOUT starting the
+        background resync/cleanup loops. The simulator uses this
+        directly: it drains the retry queues itself at deterministic
+        barrier points (:meth:`drain_resync_queue` /
+        :meth:`drain_cleanup_queue`), so no free-running thread may
+        race its virtual clock."""
         if self.cluster is not None:
             # Watch BEFORE the initial list so objects created during the list
             # are not lost; duplicate ADDs are tolerated (handlers key by uid).
@@ -312,6 +317,11 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 for obj in self.cluster.list_objects(kind):
                     self._on_watch_event(kind, ADDED, obj)
             self._synced = True
+
+    def run(self, stop_event: Optional[threading.Event] = None) -> None:
+        """Start ingest + resync/cleanup loops (reference cache.go:355-377)."""
+        self._stop = stop_event or threading.Event()
+        self.start_ingest()
         threading.Thread(
             target=self._process_resync_loop, daemon=True, name="cache-resync"
         ).start()
@@ -349,6 +359,67 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 logger.exception("failed to resync task %s/%s", task.namespace, task.name)
                 self._stop.wait(self._retry_delay(attempt))
                 self._resync_task(task, attempt + 1)
+
+    def drain_resync_queue(self) -> int:
+        """Synchronously reconcile every queued failed-side-effect task,
+        in sorted order (queue arrival order depends on worker-thread
+        timing; sorting makes the drain — and therefore a simulated
+        cycle's end state — deterministic). Returns the number of tasks
+        processed. The background resync loop and this drain are
+        mutually exclusive by construction: the loop only runs when
+        :meth:`run` started it, the drain is for callers that used
+        :meth:`start_ingest`."""
+        tasks = []
+        while True:
+            try:
+                tasks.append(self.err_tasks.get_nowait())
+            except queue.Empty:
+                break
+        tasks.sort(key=lambda item: (
+            item[0].namespace, item[0].name, item[0].uid
+        ))
+        synced = 0
+        for task, attempt in tasks:
+            try:
+                self._sync_task(task)
+                synced += 1
+            except Exception:
+                # Mirror the background loop's retry contract: a failed
+                # reconcile goes back on the queue (attempt+1) for the
+                # next drain instead of silently dropping the task into
+                # permanent staleness. Only SUCCESSFUL syncs count
+                # toward the return value, so a poisoned task cannot
+                # spin the caller's drain-until-quiescent loop.
+                logger.exception(
+                    "failed to resync task %s/%s during drain; requeued",
+                    task.namespace, task.name,
+                )
+                self._resync_task(task, attempt + 1)
+        return synced
+
+    def drain_cleanup_queue(self) -> int:
+        """Synchronously process the deleted-job queue once: terminated
+        jobs are removed from the mirror, the rest are re-queued (the
+        loop form waits with backoff; the drain leaves them for the next
+        barrier). Returns the number of jobs actually removed."""
+        jobs = []
+        while True:
+            try:
+                jobs.append(self.deleted_jobs.get_nowait())
+            except queue.Empty:
+                break
+        removed = 0
+        for job, attempt in sorted(
+            jobs, key=lambda item: item[0].uid
+        ):
+            with self.mutex:
+                terminated = job_terminated(job)
+                if terminated:
+                    self.jobs.pop(job.uid, None)
+                    removed += 1
+            if not terminated:
+                self._queue_job_cleanup(job, attempt + 1)
+        return removed
 
     def _process_cleanup_loop(self) -> None:
         """reference cache.go:556-585 (waits for JobTerminated)"""

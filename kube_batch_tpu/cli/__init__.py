@@ -38,6 +38,7 @@ __all__ = [
 def main(argv=None) -> None:
     """reference cmd/kube-batch/main.go:38."""
     import logging
+    import sys
 
     from ..version import print_version_and_exit
 
@@ -49,6 +50,14 @@ def main(argv=None) -> None:
     # (reference main.go:33-35).
     from .. import actions as _actions  # noqa: F401
     from .. import plugins as _plugins  # noqa: F401
+
+    args = sys.argv[1:] if argv is None else list(argv)
+    if args and args[0] == "sim":
+        # Subcommand: the deterministic cluster simulator
+        # (kube_batch_tpu/sim). `python -m kube_batch_tpu sim --help`.
+        from ..sim.cli import main as sim_main
+
+        sys.exit(sim_main(args[1:]))
 
     opt = parse_options(argv)
     if opt.print_version:

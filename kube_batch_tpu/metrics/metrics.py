@@ -289,6 +289,36 @@ solver_sparse_slab_bytes = REGISTRY.register(
         "(cand_idx/cand_static/cand_info) by the snapshot pack",
     )
 )
+# Scheduling-loop robustness + simulator counters (the long-horizon
+# harness in kube_batch_tpu/sim must be observable like everything
+# else: a fault run that silently stops injecting, or an invariant
+# violation eaten by a log filter, would void the whole exercise).
+scheduler_cycle_errors = REGISTRY.register(
+    Counter(
+        "scheduler_cycle_errors_total",
+        "Scheduling cycles that raised (caught by the guarded loop, "
+        "retried with capped exponential backoff)",
+    )
+)
+sim_cycles = REGISTRY.register(
+    Counter("sim_cycles_total", "Simulated scheduling cycles driven")
+)
+sim_faults_injected = REGISTRY.register(
+    Counter(
+        "sim_faults_injected_total",
+        "Simulator faults injected by kind "
+        "(bind/node-flap/node-death/evict/solver/crash)",
+    ),
+    ("kind",),
+)
+sim_invariant_violations = REGISTRY.register(
+    Counter(
+        "sim_invariant_violations_total",
+        "Invariant-checker violations by invariant "
+        "(oversubscribe/gang/conservation/queue-share)",
+    ),
+    ("invariant",),
+)
 
 
 # Update helpers (reference metrics.go:122-170).
@@ -410,3 +440,20 @@ def update_solver_sparse(
 def update_solver_jit_cache(count: int) -> None:
     """Gauge of compiled solver/patch variants (retrace forensics)."""
     solver_jit_compilations.set(float(count))
+
+
+def register_cycle_error() -> None:
+    """One scheduling cycle raised and was absorbed by the guarded loop."""
+    scheduler_cycle_errors.inc()
+
+
+def register_sim_cycle() -> None:
+    sim_cycles.inc()
+
+
+def register_sim_fault(kind: str) -> None:
+    sim_faults_injected.inc((kind,))
+
+
+def register_sim_violation(invariant: str) -> None:
+    sim_invariant_violations.inc((invariant,))

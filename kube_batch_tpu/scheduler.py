@@ -37,12 +37,38 @@ def load_scheduler_conf(confstr: str) -> Tuple[List[Action], List[Tier]]:
     return actions, conf.tiers
 
 
+class _WallClock:
+    """Default scheduler pacing: real time. The simulator injects
+    ``sim.clock.VirtualClock`` (same surface) to drive thousands of
+    cycles in virtual time; ``real`` gates wall-clock-bounded side work
+    (the think-time side-effect drain)."""
+
+    real = True
+
+    def now(self) -> float:
+        return time.perf_counter()
+
+    def wait(self, event: threading.Event, seconds: float) -> bool:
+        if seconds <= 0:
+            return event.is_set()
+        return event.wait(seconds)
+
+
 class Scheduler:
+    # Per-cycle error backoff (capped exponential): a persistently
+    # failing cycle must not busy-spin the loop, and a transient fault
+    # (an injected bind storm, a wedged backend probe) must not kill the
+    # process — the reference's wait.Until keeps the loop alive the same
+    # way.
+    CYCLE_ERROR_BACKOFF_BASE = 0.5
+    CYCLE_ERROR_BACKOFF_MAX = 30.0
+
     def __init__(
         self,
         cache,
         scheduler_conf: Optional[str] = None,
         schedule_period: float = 1.0,
+        clock=None,
     ):
         """scheduler_conf: YAML policy string or path to one; defaults to the
         reference default policy (allocate, backfill; 2 plugin tiers)."""
@@ -53,26 +79,57 @@ class Scheduler:
 
         self.cache = cache
         self.schedule_period = schedule_period
+        self.clock = clock or _WallClock()
+        self._error_streak = 0
         confstr = scheduler_conf or DEFAULT_SCHEDULER_CONF
         if "\n" not in confstr and confstr.endswith((".yaml", ".yml")):
             with open(confstr) as f:
                 confstr = f.read()
         self.actions, self.tiers = load_scheduler_conf(confstr)
 
+    def run_once_guarded(self) -> bool:
+        """One cycle that cannot kill the loop: exceptions are logged,
+        counted (``scheduler_cycle_errors_total``), and folded into the
+        error streak that drives :meth:`cycle_error_backoff`. Returns
+        True iff the cycle completed. Shared by :meth:`run` and the
+        simulator's cycle driver, so a sim fault run exercises exactly
+        the production error path."""
+        try:
+            self.run_once()
+        except Exception:
+            self._error_streak += 1
+            metrics.register_cycle_error()
+            logger.exception(
+                "scheduling cycle failed (streak %d, next backoff %.1fs)",
+                self._error_streak, self.cycle_error_backoff(),
+            )
+            return False
+        self._error_streak = 0
+        return True
+
+    def cycle_error_backoff(self) -> float:
+        """Current retry delay: base * 2^(streak-1), capped."""
+        if self._error_streak <= 0:
+            return 0.0
+        return min(
+            self.CYCLE_ERROR_BACKOFF_BASE * (2 ** (self._error_streak - 1)),
+            self.CYCLE_ERROR_BACKOFF_MAX,
+        )
+
     def run(self, stop_event: Optional[threading.Event] = None) -> None:
         """reference scheduler.go:63-85"""
         stop = stop_event or threading.Event()
+        clock = self.clock
         self.cache.run(stop)
         self.cache.wait_for_cache_sync(stop)
         while not stop.is_set():
-            start = time.perf_counter()
-            try:
-                self.run_once()
-            except Exception:
-                logger.exception("scheduling cycle failed")
-            elapsed = time.perf_counter() - start
+            start = clock.now()
+            if not self.run_once_guarded():
+                clock.wait(stop, self.cycle_error_backoff())
+                continue
+            elapsed = clock.now() - start
             remaining = max(0.0, self.schedule_period - elapsed)
-            if remaining > 0:
+            if remaining > 0 and clock.real:
                 # Think-time drain: absorb this cycle's async bind/evict
                 # backlog while the loop would otherwise sleep, so the
                 # next cycle's overlapped solve window starts from an
@@ -92,7 +149,7 @@ class Scheduler:
                 except Exception:
                     logger.exception("think-time side-effect drain failed")
                 remaining = max(0.0, deadline - time.perf_counter())
-            stop.wait(remaining)
+            clock.wait(stop, remaining)
 
     def run_once(self) -> None:
         """One scheduling cycle (reference scheduler.go:88-103). GC is

@@ -1,0 +1,135 @@
+"""``python -m kube_batch_tpu sim`` — the simulator entry point.
+
+Exit codes: 0 clean; 1 invariant violations (always — a sim run that
+breaks the contract must fail CI); 2 replay placement mismatch;
+3 scheduler-cycle errors with ``--fail-on-cycle-errors``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .harness import SIM_DEFAULT_CONF, ClusterSimulator, SimConfig
+from .trace import TraceReader
+from .workload import WorkloadSpec
+
+
+def add_sim_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--cycles", type=int, default=200,
+                        help="virtual scheduling cycles to run")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="seed for the workload + fault streams")
+    parser.add_argument(
+        "--faults", default="",
+        help="fault spec, e.g. 'bind:0.05,node-flap:0.02' (kinds: bind, "
+             "node-flap, node-death, evict, solver, crash)")
+    parser.add_argument("--nodes", type=int, default=12)
+    parser.add_argument("--node-cpu-m", type=int, default=8000)
+    parser.add_argument("--node-mem-mi", type=int, default=16384)
+    parser.add_argument(
+        "--queues", default="default:1,batch:2",
+        help="comma-separated name:weight queue set")
+    parser.add_argument("--arrival-rate", type=float, default=1.5,
+                        help="expected job arrivals per cycle")
+    parser.add_argument(
+        "--node-churn", type=float, default=0.0,
+        help="per-cycle probability of a planned node add AND drain")
+    parser.add_argument(
+        "--backend", choices=("auto", "dense", "sparse", "native"),
+        default="auto",
+        help="solver backend routing for the run (env override)")
+    parser.add_argument("--topk", type=int, default=None,
+                        help="sparse K (with --backend sparse)")
+    parser.add_argument("--scheduler-conf", default="",
+                        help="YAML policy (default: allocate_tpu,backfill)")
+    parser.add_argument("--trace", default=None, metavar="PATH",
+                        help="record the run's JSONL trace to PATH")
+    parser.add_argument(
+        "--replay", default=None, metavar="PATH",
+        help="replay a recorded trace instead of generating events; "
+             "per-cycle placements are verified against the recording")
+    parser.add_argument("--no-check", dest="check", action="store_false",
+                        default=True, help="skip the invariant checker")
+    parser.add_argument("--fail-on-cycle-errors", action="store_true",
+                        help="exit 3 if any scheduling cycle raised")
+    parser.add_argument("--quiet", action="store_true",
+                        help="suppress the JSON report on stdout")
+
+
+def config_from_args(ns: argparse.Namespace) -> SimConfig:
+    queues = {}
+    for term in ns.queues.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        name, _, weight = term.partition(":")
+        queues[name] = int(weight or 1)
+    workload = WorkloadSpec(
+        nodes=ns.nodes,
+        node_cpu_m=ns.node_cpu_m,
+        node_mem_mi=ns.node_mem_mi,
+        queues=queues or {"default": 1},
+        arrival_rate=ns.arrival_rate,
+        node_add_rate=ns.node_churn,
+        node_drain_rate=ns.node_churn,
+    )
+    # Replay normalization (cycles/seed/faults/period from the trace
+    # header) is owned by ClusterSimulator.__init__ — single site.
+    replay = TraceReader.load(ns.replay) if ns.replay else None
+    return SimConfig(
+        cycles=ns.cycles,
+        seed=ns.seed,
+        faults=ns.faults,
+        workload=workload,
+        conf=ns.scheduler_conf or SIM_DEFAULT_CONF,
+        backend=ns.backend,
+        topk=ns.topk,
+        trace_path=ns.trace,
+        replay=replay,
+        check_invariants=ns.check,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpu-batch sim",
+        description="deterministic long-horizon cluster simulator",
+    )
+    add_sim_flags(parser)
+    ns = parser.parse_args(argv)
+    cfg = config_from_args(ns)
+
+    sim = ClusterSimulator(cfg)
+    report = sim.run()
+
+    out = report.to_dict()
+    out["seed"] = cfg.seed
+    out["backend"] = cfg.backend
+    out["faults"] = cfg.faults
+    out["replayed"] = cfg.replay is not None
+    if not ns.quiet:
+        print(json.dumps(out, indent=2, sort_keys=True))
+
+    if report.violations:
+        print(
+            f"sim: {len(report.violations)} invariant violation(s)",
+            file=sys.stderr,
+        )
+        return 1
+    if report.replay_mismatches:
+        print(
+            f"sim: replay diverged at cycles "
+            f"{report.replay_mismatches[:10]}",
+            file=sys.stderr,
+        )
+        return 2
+    if ns.fail_on_cycle_errors and report.cycle_errors:
+        print(
+            f"sim: {report.cycle_errors} scheduling cycle error(s)",
+            file=sys.stderr,
+        )
+        return 3
+    return 0

@@ -1,0 +1,229 @@
+"""Deterministic fault injection.
+
+Spec grammar (doc/design/simulator.md): comma-separated
+``kind:probability`` terms, e.g. ``"bind:0.05,node-flap:0.02"``.
+
+| kind           | seam | effect |
+|----------------|------|--------|
+| ``bind``       | Binder wrapper | bind side effect raises; the cache's resync path re-pends the task |
+| ``node-flap``  | pre-cycle      | node removed (pods killed + recreated Pending), returns after a seeded 1-4 cycles |
+| ``node-death`` | mid-cycle      | node doomed for the cycle: every bind to it fails AND the first one deletes the node under the in-flight batch; permanent |
+| ``evict``      | pre-cycle      | one seeded Running pod deleted (external eviction race); recreated Pending |
+| ``solver``     | per-cycle env  | forces ``KBT_SOLVER=native`` for the cycle (accelerator-backend failure → native fallback) |
+| ``crash``      | action shim    | a raising action is prepended for the cycle, exercising the scheduler's guarded-cycle error backoff |
+
+Two determinism regimes:
+- cycle-planned faults (flap/death/evict/solver/crash) are drawn from a
+  seeded stream in the sim thread BEFORE the cycle runs and recorded in
+  the trace as fault events;
+- per-bind failures are decided by a pure hash of
+  ``(seed, pod uid, attempt#)`` — bind side effects run concurrently on
+  the cache's worker pool, so a shared RNG stream there would make the
+  decision order (hence the decisions) timing-dependent. A hash keyed
+  on stable identities is thread-safe AND replays bit-identically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+from typing import Dict, List, Sequence, Set, Tuple
+
+FAULT_KINDS = ("bind", "node-flap", "node-death", "evict", "solver", "crash")
+
+
+class SimBindFailure(RuntimeError):
+    """Injected bind failure (distinguishable from real bind errors)."""
+
+
+def parse_fault_spec(spec: str) -> Dict[str, float]:
+    """``"bind:0.05,node-flap:0.02"`` → ``{"bind": 0.05, ...}``.
+    Unknown kinds and out-of-range probabilities are hard errors — a
+    typo silently injecting nothing would green-light a broken run."""
+    out: Dict[str, float] = {}
+    for term in (spec or "").split(","):
+        term = term.strip()
+        if not term:
+            continue
+        kind, sep, prob = term.partition(":")
+        kind = kind.strip()
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} (known: {', '.join(FAULT_KINDS)})"
+            )
+        if not sep:
+            raise ValueError(f"fault term {term!r} missing ':probability'")
+        p = float(prob)
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"fault probability out of [0,1]: {term!r}")
+        out[kind] = p
+    return out
+
+
+def _hash01(*parts) -> float:
+    """Stable uniform [0,1) from identity parts (independent of
+    PYTHONHASHSEED and thread timing)."""
+    h = hashlib.blake2b(
+        "\x1f".join(str(p) for p in parts).encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "big") / 2**64
+
+
+class _FaultyBinder:
+    """Binder wrapper: consults the injector before delegating."""
+
+    def __init__(self, inner, injector: "FaultInjector"):
+        self.inner = inner
+        self.injector = injector
+
+    def bind(self, pod, hostname: str) -> None:
+        self.injector.on_bind(pod, hostname)
+        self.inner.bind(pod, hostname)
+
+
+class _CrashAction:
+    """Prepended for a crash-fault cycle: run_once raises, the guarded
+    scheduler loop must absorb it."""
+
+    def name(self) -> str:
+        return "sim-crash"
+
+    def initialize(self) -> None:
+        pass
+
+    def un_initialize(self) -> None:
+        pass
+
+    def execute(self, ssn) -> None:
+        raise SimBindFailure("injected scheduler-cycle crash")
+
+
+class FaultInjector:
+    def __init__(self, spec: Dict[str, float], seed: int):
+        self.spec = dict(spec or {})
+        self.seed = seed
+        self.rng = random.Random(f"{seed}/faults")
+        self._lock = threading.Lock()
+        self._bind_attempts: Dict[str, int] = {}
+        self._cycle = -1
+        self._active = False
+        # Mid-cycle death state: nodes doomed this cycle, and the
+        # cluster handle used to delete them under the in-flight batch.
+        self._doomed: Set[str] = set()
+        self._cluster = None
+        self._killed_mid_cycle: Set[str] = set()
+        # Forensics drained by the harness each cycle. _bind_faults
+        # counts the hash-decided failures only (doomed-node rejections
+        # ride under their planned node-death event).
+        self._bind_failures: List[Tuple[str, str]] = []
+        self._bind_faults = 0
+
+    # -- wiring --------------------------------------------------------------
+
+    def wrap_binder(self, binder):
+        if binder is None:
+            return None
+        return _FaultyBinder(binder, self)
+
+    def attach_cluster(self, cluster) -> None:
+        self._cluster = cluster
+
+    crash_action_factory = _CrashAction
+
+    # -- cycle planning (sim thread, deterministic stream) -------------------
+
+    def plan_cycle(
+        self,
+        cycle: int,
+        node_names: Sequence[str],
+        running_pods: Sequence[str],
+    ) -> List[dict]:
+        """Draw this cycle's planned faults. Returns trace-ready fault
+        event dicts; the harness applies them (and ``begin_cycle`` arms
+        the bind/doom seams)."""
+        rng, spec = self.rng, self.spec
+        events: List[dict] = []
+        p_flap = spec.get("node-flap", 0.0)
+        if p_flap and node_names and rng.random() < p_flap:
+            victim = rng.choice(sorted(node_names))
+            down_for = rng.randint(1, 4)
+            events.append({
+                "kind": "node-flap", "name": victim, "down_for": down_for,
+            })
+        p_death = spec.get("node-death", 0.0)
+        if p_death and node_names and rng.random() < p_death:
+            victim = rng.choice(sorted(node_names))
+            events.append({"kind": "node-death", "name": victim})
+        p_evict = spec.get("evict", 0.0)
+        if p_evict and running_pods and rng.random() < p_evict:
+            victim = rng.choice(sorted(running_pods))
+            events.append({"kind": "evict", "pod": victim})
+        if spec.get("solver", 0.0) and rng.random() < spec["solver"]:
+            events.append({"kind": "solver"})
+        if spec.get("crash", 0.0) and rng.random() < spec["crash"]:
+            events.append({"kind": "crash"})
+        return events
+
+    # -- cycle arming --------------------------------------------------------
+
+    def begin_cycle(self, cycle: int, doomed_nodes: Sequence[str] = ()) -> None:
+        with self._lock:
+            self._cycle = cycle
+            self._active = True
+            self._doomed = set(doomed_nodes)
+            self._killed_mid_cycle = set()
+
+    def end_cycle(self) -> dict:
+        """Disarm and drain the cycle's bind-seam forensics."""
+        with self._lock:
+            self._active = False
+            failures = sorted(self._bind_failures)
+            self._bind_failures = []
+            killed = sorted(self._killed_mid_cycle)
+            self._doomed = set()
+            bind_faults = self._bind_faults
+            self._bind_faults = 0
+        return {
+            "bind_failures": failures,
+            "nodes_killed": killed,
+            "bind_faults": bind_faults,
+        }
+
+    # -- the bind seam (side-effect pool threads) ----------------------------
+
+    def on_bind(self, pod, hostname: str) -> None:
+        key = f"{pod.namespace}/{pod.name}"
+        with self._lock:
+            if not self._active:
+                return
+            doomed = hostname in self._doomed
+            kill_node = doomed and hostname not in self._killed_mid_cycle
+            if kill_node:
+                self._killed_mid_cycle.add(hostname)
+            if not doomed:
+                attempt = self._bind_attempts.get(pod.uid, 0)
+                self._bind_attempts[pod.uid] = attempt + 1
+                p = self.spec.get("bind", 0.0)
+                fail = p > 0 and _hash01(
+                    self.seed, "bind", pod.uid, attempt
+                ) < p
+                if not fail:
+                    return
+                # Planned faults (flap/death/evict/...) are counted by
+                # the harness when it applies their events; only the
+                # per-bind hash decisions are counted here.
+                self._bind_faults += 1
+            self._bind_failures.append((key, hostname))
+        if kill_node and self._cluster is not None:
+            # Delete the node UNDER the in-flight bind batch: the watch
+            # event lands in the cache synchronously, so the remaining
+            # staged binds of this node see it vanish mid-cycle.
+            for node in self._cluster.list_objects("Node"):
+                if node.name == hostname:
+                    self._cluster.delete("Node", node)
+                    break
+        raise SimBindFailure(
+            f"injected {'node-death' if doomed else 'bind'} failure: "
+            f"{key} -> {hostname}"
+        )

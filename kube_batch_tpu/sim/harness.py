@@ -1,0 +1,719 @@
+"""ClusterSimulator: the event loop that drives the real scheduler.
+
+One simulated cycle:
+
+1. apply this cycle's EVENTS (workload arrivals/completions/churn —
+   from the seeded generator, or verbatim from a replayed trace);
+2. apply + arm this cycle's FAULTS (planned from the seeded fault
+   stream, or from the trace);
+3. run ONE real scheduling cycle (``Scheduler.run_once_guarded`` — the
+   production ``run_once``, crash faults included);
+4. BARRIER: wait out every async bind/evict side effect, then drain the
+   cache's resync and cleanup queues deterministically — virtual time
+   only advances when the world has settled, which is what makes the
+   run replayable;
+5. post-cycle cleanup (pods orphaned by a mid-cycle node death), gang
+   degradation bookkeeping, invariant check, trace record.
+
+The scheduler, cache, plugins, and actions are the production objects —
+the simulator only owns the clock, the churn, and the assertions.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .. import metrics
+from ..api import PodPhase, build_resource_list
+from ..cache import SchedulerCache
+from ..cluster import InProcessCluster
+from ..scheduler import Scheduler
+from ..utils.test_utils import build_node, build_pod, build_pod_group, build_queue
+from .clock import VirtualClock
+from .faults import FaultInjector, parse_fault_spec
+from .invariants import InvariantChecker
+from .trace import TRACE_VERSION, TraceReader, TraceWriter
+from .workload import WorkloadGenerator, WorkloadSpec
+
+logger = logging.getLogger(__name__)
+
+SIM_NAMESPACE = "sim"
+
+SIM_DEFAULT_CONF = """
+actions: "allocate_tpu, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+# Backend name -> env overrides (None = unset). "auto" leaves the
+# process environment alone.
+_BACKEND_ENV = {
+    "dense": {"KBT_SOLVER": "jax", "KBT_SOLVER_TOPK": "off"},
+    "sparse": {"KBT_SOLVER": "jax"},
+    "native": {"KBT_SOLVER": "native", "KBT_SOLVER_TOPK": None},
+}
+
+
+@dataclass
+class SimConfig:
+    cycles: int = 200
+    seed: int = 0
+    faults: str = ""
+    workload: WorkloadSpec = field(default_factory=WorkloadSpec)
+    conf: str = SIM_DEFAULT_CONF
+    backend: str = "auto"           # auto | dense | sparse | native
+    topk: Optional[int] = None      # sparse K override (KBT_SOLVER_TOPK)
+    period: float = 1.0             # virtual seconds per cycle
+    trace_path: Optional[str] = None
+    replay: Optional[TraceReader] = None
+    check_invariants: bool = True
+    recreate_killed: bool = True    # controller analog for killed pods
+
+
+@dataclass
+class SimReport:
+    cycles: int = 0
+    placements: int = 0
+    violations: List[dict] = field(default_factory=list)
+    fault_counts: Dict[str, int] = field(default_factory=dict)
+    bind_failures: int = 0
+    cycle_errors: int = 0
+    replay_mismatches: List[int] = field(default_factory=list)
+    jobs_created: int = 0
+    jobs_completed: int = 0
+    wall_seconds: float = 0.0
+    check_seconds: float = 0.0
+
+    @property
+    def cycles_per_sec(self) -> float:
+        return self.cycles / self.wall_seconds if self.wall_seconds else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "cycles": self.cycles,
+            "placements": self.placements,
+            "violations": self.violations,
+            "fault_counts": {
+                k: v for k, v in sorted(self.fault_counts.items()) if v
+            },
+            "bind_failures": self.bind_failures,
+            "cycle_errors": self.cycle_errors,
+            "replay_mismatches": self.replay_mismatches,
+            "jobs_created": self.jobs_created,
+            "jobs_completed": self.jobs_completed,
+            "wall_seconds": round(self.wall_seconds, 3),
+            "cycles_per_sec": round(self.cycles_per_sec, 1),
+            "invariant_check_seconds": round(self.check_seconds, 3),
+        }
+
+
+class _RecordingBinder:
+    """Outermost binder layer: records successful binds (the cycle's
+    placements). Appends AFTER the inner bind returns, so injected
+    failures never show up as placements."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.records: List[Tuple[str, str]] = []
+
+    def bind(self, pod, hostname: str) -> None:
+        self.inner.bind(pod, hostname)
+        self.records.append((f"{pod.namespace}/{pod.name}", hostname))
+
+    def drain(self) -> List[List[str]]:
+        out = sorted(self.records)
+        self.records = []
+        return [list(p) for p in out]
+
+
+class ClusterSimulator:
+    def __init__(self, cfg: SimConfig):
+        if cfg.replay is not None:
+            # The recorded run's identity lives in its header: the bind
+            # fault seam re-decides per-attempt failures from
+            # (seed, fault spec), so replaying under CLI defaults would
+            # silently inject a DIFFERENT fault pattern and report it as
+            # scheduler divergence.
+            header = cfg.replay.header
+            cfg.seed = header.get("seed", cfg.seed)
+            cfg.faults = header.get("faults", cfg.faults)
+            cfg.period = header.get("period", cfg.period)
+            cfg.cycles = len(cfg.replay.cycles)
+        self.cfg = cfg
+        self.clock = VirtualClock()
+        # Validate BEFORE mutating process state: a bad fault spec must
+        # not leak env overrides or a live cache thread pool.
+        fault_spec = parse_fault_spec(cfg.faults)
+        self._env_backup: Dict[str, Optional[str]] = {}
+        self._apply_backend_env(cfg.backend, cfg.topk)
+        try:
+            self.cluster = InProcessCluster(simulate_kubelet=True)
+            self.cache = SchedulerCache(
+                cluster=self.cluster,
+                scheduler_name="tpu-batch",
+                default_queue="default",
+            )
+            self.injector = FaultInjector(fault_spec, cfg.seed)
+            self.injector.attach_cluster(self.cluster)
+            self.cache.binder = self.binder = _RecordingBinder(
+                self.injector.wrap_binder(self.cache.binder)
+            )
+            # Ingest without the background resync/cleanup loops: the
+            # sim drains those queues itself at deterministic points.
+            self.cache.start_ingest()
+            self.scheduler = Scheduler(
+                self.cache,
+                scheduler_conf=cfg.conf,
+                schedule_period=cfg.period,
+                clock=self.clock,
+            )
+            self.checker = InvariantChecker()
+            self.writer = TraceWriter(cfg.trace_path)
+            self.replaying = cfg.replay is not None
+            if self.replaying:
+                self.generator = None
+            else:
+                self.generator = WorkloadGenerator(cfg.workload, cfg.seed)
+        except BaseException:
+            if getattr(self, "cache", None) is not None:
+                self.cache.shutdown()
+            self._restore_env()
+            raise
+
+        self.report = SimReport()
+        # Deterministic bookkeeping.
+        self._seq = 0                      # event timestamp tiebreaker
+        self._job_specs: Dict[str, dict] = {}
+        self._rebirths: Dict[str, int] = {}
+        self._running_since: Dict[str, int] = {}
+        # Generate-mode future event queues (flap returns, recreations).
+        self._scheduled: Dict[int, List[dict]] = {}
+
+    # -- environment ---------------------------------------------------------
+
+    def _apply_backend_env(self, backend: str, topk: Optional[int]) -> None:
+        overrides = dict(_BACKEND_ENV.get(backend, {}))
+        if backend == "sparse":
+            overrides["KBT_SOLVER_TOPK"] = str(topk or 64)
+        for key, value in overrides.items():
+            self._env_backup[key] = os.environ.get(key)
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+    def _restore_env(self) -> None:
+        for key, value in self._env_backup.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        self._env_backup = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        try:
+            self.cache.shutdown()
+        finally:
+            self.writer.close()
+            self._restore_env()
+
+    def run(self) -> SimReport:
+        cfg = self.cfg
+        started = time.perf_counter()
+        try:
+            self._write_header()
+            self._bootstrap()
+            for cycle in range(cfg.cycles):
+                self._run_cycle(cycle)
+                self.clock.advance(cfg.period)
+            self.report.cycles = cfg.cycles
+        finally:
+            self.report.wall_seconds = time.perf_counter() - started
+            self.close()
+        return self.report
+
+    def _write_header(self) -> None:
+        cfg = self.cfg
+        if self.replaying:
+            header = dict(cfg.replay.header)
+            header["replayed"] = True
+            header["backend"] = cfg.backend
+        else:
+            header = {
+                "type": "header",
+                "version": TRACE_VERSION,
+                "seed": cfg.seed,
+                "cycles": cfg.cycles,
+                "faults": cfg.faults,
+                "backend": cfg.backend,
+                "period": cfg.period,
+                "workload": cfg.workload.to_dict(),
+            }
+        self.writer.write(header)
+
+    def _bootstrap(self) -> None:
+        if self.replaying:
+            return  # cycle 0's recorded events carry the bootstrap
+        for event in self.generator.initial_events():
+            self._scheduled.setdefault(0, []).append(event)
+
+    # -- the cycle -----------------------------------------------------------
+
+    def _run_cycle(self, cycle: int) -> None:
+        cfg = self.cfg
+
+        # 1. events
+        if self.replaying:
+            rec = (
+                cfg.replay.cycles[cycle]
+                if cycle < len(cfg.replay.cycles) else {}
+            )
+            events = list(rec.get("events", []))
+            fault_events = list(rec.get("faults", []))
+        else:
+            rec = None
+            events = self._scheduled.pop(cycle, [])
+            events.extend(self.generator.events_for_cycle(
+                cycle, self._running_since, self._node_names()
+            ))
+        for event in events:
+            self._apply_event(event, cycle)
+        if not self.replaying:
+            # Faults are planned AFTER this cycle's events have landed:
+            # targeting pre-event state would let a flap pick a node
+            # drained this very cycle (its scheduled return would then
+            # resurrect a permanently-removed node) or an evict pick a
+            # pod whose job-delete already ran (a recorded "fault" that
+            # injected nothing).
+            fault_events = self.injector.plan_cycle(
+                cycle, self._node_names(), self._running_pod_keys()
+            )
+
+        # 2. faults
+        doomed: List[str] = []
+        solver_fault = crash_fault = False
+        for fault in fault_events:
+            kind = fault["kind"]
+            self.report.fault_counts[kind] = (
+                self.report.fault_counts.get(kind, 0) + 1
+            )
+            metrics.register_sim_fault(kind)
+            if kind == "node-flap":
+                self._kill_node(fault["name"], cycle, reason="flap")
+                if not self.replaying:
+                    self._scheduled.setdefault(
+                        cycle + fault["down_for"], []
+                    ).append(self._node_add_event(fault["name"]))
+            elif kind == "node-death":
+                doomed.append(fault["name"])
+            elif kind == "evict":
+                self._kill_pod(fault["pod"], cycle)
+            elif kind == "solver":
+                solver_fault = True
+            elif kind == "crash":
+                crash_fault = True
+
+        # 3. one real scheduling cycle
+        self.injector.begin_cycle(cycle, doomed_nodes=doomed)
+        prev_solver = None
+        if solver_fault:
+            prev_solver = os.environ.get("KBT_SOLVER")
+            os.environ["KBT_SOLVER"] = "native"
+        if crash_fault:
+            self.scheduler.actions.insert(
+                0, self.injector.crash_action_factory()
+            )
+        try:
+            ok = self.scheduler.run_once_guarded()
+        finally:
+            if crash_fault:
+                self.scheduler.actions.pop(0)
+            if solver_fault:
+                if prev_solver is None:
+                    os.environ.pop("KBT_SOLVER", None)
+                else:
+                    os.environ["KBT_SOLVER"] = prev_solver
+        if not ok:
+            self.report.cycle_errors += 1
+            # The guarded production loop would back off; virtual time
+            # pays the same penalty.
+            self.clock.advance(self.scheduler.cycle_error_backoff())
+
+        # 4. barrier + deterministic queue drains
+        self._settle()
+        seam = self.injector.end_cycle()
+        for pod_key, _host in seam["bind_failures"]:
+            self._degrade_pod(pod_key, cycle)
+        self.report.bind_failures += len(seam["bind_failures"])
+        # Hash-decided bind faults (a subset of the seam failures — the
+        # rest are doomed-node rejections) count as injected faults too.
+        for _ in range(seam["bind_faults"]):
+            metrics.register_sim_fault("bind")
+        if seam["bind_faults"]:
+            self.report.fault_counts["bind"] = (
+                self.report.fault_counts.get("bind", 0)
+                + seam["bind_faults"]
+            )
+
+        # 5. post-cycle cleanup (orphans of mid-cycle node deaths)
+        if self.replaying:
+            post_events = list((rec or {}).get("post_events", []))
+        else:
+            post_events = self._plan_post_events(cycle, doomed, seam)
+        for event in post_events:
+            self._apply_event(event, cycle)
+        if post_events:
+            self._settle()
+
+        placements = self.binder.drain()
+        self._update_running_since(cycle)
+
+        # 6. invariants
+        violations = []
+        if cfg.check_invariants:
+            t0 = time.perf_counter()
+            violations = [
+                v.to_dict() for v in self.checker.check(
+                    self.cache, cycle, namespace=SIM_NAMESPACE
+                )
+            ]
+            self.report.check_seconds += time.perf_counter() - t0
+            for v in violations:
+                metrics.register_sim_violation(v["invariant"])
+            self.report.violations.extend(violations)
+        metrics.register_sim_cycle()
+        self.report.placements += len(placements)
+
+        record = {
+            "type": "cycle",
+            "cycle": cycle,
+            "events": events,
+            "faults": fault_events,
+            "post_events": post_events,
+            "placements": placements,
+            "bind_failures": [list(b) for b in seam["bind_failures"]],
+            "stats": self._cycle_stats(),
+            "violations": violations,
+        }
+        self.writer.write(record)
+        if self.replaying and rec is not None:
+            if placements != rec.get("placements", []):
+                self.report.replay_mismatches.append(cycle)
+
+    # -- settling ------------------------------------------------------------
+
+    def _settle(self) -> None:
+        """Quiesce: all async side effects done, resync/cleanup queues
+        drained (in sorted order — queue arrival order depends on worker
+        timing), repeated until a full pass changes nothing."""
+        for _ in range(8):
+            if not self.cache.wait_for_side_effects(timeout=60.0):
+                logger.warning("sim settle: side effects still in flight")
+            resynced = self.cache.drain_resync_queue()
+            cleaned = self.cache.drain_cleanup_queue()
+            if not resynced and not cleaned:
+                return
+        logger.warning("sim settle: world still churning after 8 passes")
+
+    # -- event application ---------------------------------------------------
+
+    def _next_ts(self, cycle: int) -> float:
+        self._seq += 1
+        return cycle * self.cfg.period + self._seq * 1e-6
+
+    def _node_names(self) -> List[str]:
+        return sorted(
+            n.name for n in self.cluster.list_objects("Node")
+        )
+
+    def _running_pod_keys(self) -> List[str]:
+        return sorted(
+            f"{p.namespace}/{p.name}"
+            for p in self.cluster.list_objects("Pod")
+            if p.namespace == SIM_NAMESPACE
+            and p.status.phase == PodPhase.RUNNING
+        )
+
+    def _node_add_event(self, name: str) -> dict:
+        spec = self.cfg.workload
+        return {
+            "kind": "node-add", "name": name,
+            "cpu_m": spec.node_cpu_m, "mem_mi": spec.node_mem_mi,
+        }
+
+    def _apply_event(self, event: dict, cycle: int) -> None:
+        kind = event["kind"]
+        if kind == "queue-add":
+            q = build_queue(event["name"], weight=event["weight"])
+            q.metadata.uid = f"uid-queue-{event['name']}"
+            q.metadata.creation_timestamp = self._next_ts(cycle)
+            self.cluster.create_queue(q)
+        elif kind == "node-add":
+            node = build_node(event["name"], build_resource_list(
+                cpu=f"{event['cpu_m']}m",
+                memory=f"{event['mem_mi']}Mi",
+                pods=110,
+            ))
+            node.metadata.uid = f"uid-node-{event['name']}"
+            node.metadata.creation_timestamp = self._next_ts(cycle)
+            self.cluster.create_node(node)
+        elif kind == "node-remove":
+            self._kill_node(event["name"], cycle, reason=event.get(
+                "reason", "drain"
+            ))
+        elif kind == "job-create":
+            self._create_job(event, cycle)
+        elif kind == "job-complete":
+            self._complete_job(event["name"], cycle)
+        elif kind == "job-delete":
+            self._delete_job(event["name"])
+        elif kind == "pod-recreate":
+            self._recreate_pods(event, cycle)
+        elif kind == "pod-delete":
+            self._kill_pod(event["pod"], cycle, recreate=False)
+        else:
+            raise ValueError(f"unknown sim event kind {kind!r}")
+
+    def _create_job(self, event: dict, cycle: int) -> None:
+        name = event["name"]
+        self._job_specs[name] = dict(event)
+        self.report.jobs_created += 1
+        ts = self._next_ts(cycle)
+        pg = build_pod_group(
+            name, namespace=SIM_NAMESPACE,
+            min_member=event["min_member"], queue=event["queue"],
+        )
+        pg.metadata.uid = f"uid-pg-{name}"
+        pg.metadata.creation_timestamp = ts
+        self.cluster.create_pod_group(pg)
+        req = build_resource_list(
+            cpu=f"{event['cpu_m']}m", memory=f"{event['mem_mi']}Mi"
+        )
+        for i in range(event["replicas"]):
+            self._create_pod(name, f"{name}-{i}", req, ts)
+
+    def _create_pod(self, job: str, pod_name: str, req, ts: float) -> None:
+        pod = build_pod(
+            SIM_NAMESPACE, pod_name, "", PodPhase.PENDING, dict(req),
+            group_name=job,
+        )
+        pod.metadata.creation_timestamp = ts
+        self.cluster.create_pod(pod)
+
+    def _complete_job(self, name: str, cycle: int) -> None:
+        self.report.jobs_completed += 1
+        for pod in self._job_pods(name):
+            if pod.status.phase == PodPhase.RUNNING:
+                pod.status.phase = PodPhase.SUCCEEDED
+                self.cluster.update("Pod", pod)
+        self._running_since.pop(name, None)
+
+    def _delete_job(self, name: str) -> None:
+        for pod in self._job_pods(name):
+            self.cluster.delete_pod(pod)
+        for pg in self.cluster.list_objects("PodGroup"):
+            if pg.namespace == SIM_NAMESPACE and pg.name == name:
+                self.cluster.delete("PodGroup", pg)
+        self._job_specs.pop(name, None)
+        self._running_since.pop(name, None)
+        self._rebirths = {
+            k: v for k, v in self._rebirths.items()
+            if not k.startswith(f"{name}-")
+        }
+
+    def _job_pods(self, job: str):
+        from ..api.objects import GROUP_NAME_ANNOTATION_KEY
+
+        return sorted(
+            (
+                p for p in self.cluster.list_objects("Pod")
+                if p.namespace == SIM_NAMESPACE
+                and p.metadata.annotations.get(
+                    GROUP_NAME_ANNOTATION_KEY
+                ) == job
+            ),
+            key=lambda p: p.name,
+        )
+
+    def _job_of_pod(self, pod) -> Optional[str]:
+        from ..api.objects import GROUP_NAME_ANNOTATION_KEY
+
+        return pod.metadata.annotations.get(GROUP_NAME_ANNOTATION_KEY)
+
+    def _kill_node(self, name: str, cycle: int, reason: str) -> None:
+        for node in self.cluster.list_objects("Node"):
+            if node.name == name:
+                self.cluster.delete("Node", node)
+                break
+        for pod in sorted(
+            (
+                p for p in self.cluster.list_objects("Pod")
+                if p.namespace == SIM_NAMESPACE
+                and p.spec.node_name == name
+            ),
+            key=lambda p: p.name,
+        ):
+            self._kill_pod(f"{pod.namespace}/{pod.name}", cycle)
+
+    def _kill_pod(self, pod_key: str, cycle: int, recreate: bool = True) -> None:
+        ns, _, name = pod_key.partition("/")
+        pod = self.cluster.get_pod(ns, name)
+        if pod is None:
+            return
+        job = self._job_of_pod(pod)
+        self.cluster.delete_pod(pod)
+        if job:
+            self.checker.mark_degraded(f"{ns}/{job}", cycle)
+            if (
+                recreate
+                and not self.replaying
+                and self.cfg.recreate_killed
+                and job in self._job_specs
+            ):
+                self._schedule_recreation(job, name, cycle)
+
+    def _schedule_recreation(self, job: str, pod_name: str, cycle: int) -> None:
+        # "simjob-00001-3r2" → base "simjob-00001-3": rebirths of a
+        # rebirth share the original replica's generation counter.
+        stem, dash, tail = pod_name.rpartition("-")
+        base = f"{stem}{dash}{tail.split('r', 1)[0]}"
+        gen = self._rebirths.get(base, 0) + 1
+        self._rebirths[base] = gen
+        self._scheduled.setdefault(cycle + 1, []).append({
+            "kind": "pod-recreate",
+            "job": job,
+            "names": [f"{base}r{gen}"],
+        })
+
+    def _recreate_pods(self, event: dict, cycle: int) -> None:
+        job = event["job"]
+        spec = self._job_specs.get(job)
+        if spec is None:
+            return  # job finished in the meantime
+        req = build_resource_list(
+            cpu=f"{spec['cpu_m']}m", memory=f"{spec['mem_mi']}Mi"
+        )
+        ts = self._next_ts(cycle)
+        for name in event["names"]:
+            if self.cluster.get_pod(SIM_NAMESPACE, name) is not None:
+                continue
+            self._create_pod(job, name, req, ts)
+
+    def _degrade_pod(self, pod_key: str, cycle: int) -> None:
+        ns, _, name = pod_key.partition("/")
+        pod = self.cluster.get_pod(ns, name)
+        if pod is None:
+            return
+        job = self._job_of_pod(pod)
+        if job:
+            self.checker.mark_degraded(f"{ns}/{job}", cycle)
+
+    def _plan_post_events(self, cycle, doomed, seam) -> List[dict]:
+        """Generate mode: clean up after mid-cycle node deaths — the
+        node object (when no bind got to kill it first) and the Running
+        pods orphaned on it."""
+        post: List[dict] = []
+        live_nodes = set(self._node_names())
+        removed_now = set()
+        for name in doomed:
+            if name in live_nodes:
+                # The node-remove event's application (_kill_node)
+                # deletes this node's pods and schedules their
+                # recreations itself — listing them here too would
+                # recreate each orphan TWICE (r<N> and r<N+1>),
+                # permanently inflating the job.
+                post.append({
+                    "kind": "node-remove", "name": name, "reason": "death",
+                })
+                live_nodes.discard(name)
+                removed_now.add(name)
+        for pod in self.cluster.list_objects("Pod"):
+            node_name = pod.spec.node_name
+            if (
+                pod.namespace == SIM_NAMESPACE
+                and node_name
+                and node_name not in live_nodes
+                and node_name not in removed_now
+            ):
+                # Orphans of a node the injector already deleted
+                # mid-cycle: no node-remove event will clean these up.
+                post.append({
+                    "kind": "pod-delete",
+                    "pod": f"{pod.namespace}/{pod.name}",
+                })
+                job = self._job_of_pod(pod)
+                if (
+                    job is not None
+                    and self.cfg.recreate_killed
+                    and job in self._job_specs
+                ):
+                    self._schedule_recreation(job, pod.name, cycle)
+        post.sort(key=lambda e: (e["kind"], e.get("name", e.get("pod", ""))))
+        return post
+
+    # -- observation ---------------------------------------------------------
+
+    def _update_running_since(self, cycle: int) -> None:
+        running: Dict[str, int] = {}
+        for pod in self.cluster.list_objects("Pod"):
+            if (
+                pod.namespace == SIM_NAMESPACE
+                and pod.status.phase == PodPhase.RUNNING
+            ):
+                job = self._job_of_pod(pod)
+                if job:
+                    running[job] = running.get(job, 0) + 1
+        for job, count in running.items():
+            spec = self._job_specs.get(job)
+            if spec is None:
+                continue
+            if count >= spec["min_member"]:
+                self._running_since.setdefault(job, cycle)
+        # A gang knocked below min_member (node death, eviction) is no
+        # longer fully running: its completion clock restarts when the
+        # reborn members bind — otherwise a half-dead job would still
+        # "succeed" on schedule with its rebirths sitting Pending.
+        for job in list(self._running_since):
+            spec = self._job_specs.get(job)
+            if spec is None:
+                continue
+            if running.get(job, 0) < spec["min_member"]:
+                del self._running_since[job]
+
+    def _cycle_stats(self) -> dict:
+        pods = [
+            p for p in self.cluster.list_objects("Pod")
+            if p.namespace == SIM_NAMESPACE
+        ]
+        return {
+            "nodes": len(self.cluster.list_objects("Node")),
+            "jobs": len(self._job_specs),
+            "pods": len(pods),
+            "running": sum(
+                1 for p in pods if p.status.phase == PodPhase.RUNNING
+            ),
+            "pending": sum(
+                1 for p in pods if p.status.phase == PodPhase.PENDING
+            ),
+        }
+
+
+def run_sim(cfg: SimConfig) -> Tuple[SimReport, List[dict]]:
+    """Run one simulation; returns (report, trace records)."""
+    sim = ClusterSimulator(cfg)
+    report = sim.run()
+    return report, sim.writer.records

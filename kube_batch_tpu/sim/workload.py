@@ -1,0 +1,206 @@
+"""Seeded synthetic workload generator.
+
+Emits per-cycle EVENT DICTS (the trace's lingua franca — the harness
+applies the same dicts whether they come from this generator or from a
+replayed trace): gang arrivals drawn from a size/req mix, completions
+after a seeded fully-running duration, and planned node add/drain
+churn. All randomness flows from one named ``random.Random`` stream so
+a (seed, spec) pair always yields the same event sequence; nothing here
+reads the wall clock (timestamps are virtual-time values the harness
+passes in).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Tuple
+
+
+@dataclass
+class WorkloadSpec:
+    """Knobs of the synthetic cluster + arrival process."""
+
+    nodes: int = 12
+    node_cpu_m: int = 8000          # per-node allocatable millicores
+    node_mem_mi: int = 16384        # per-node allocatable MiB
+    queues: Dict[str, int] = field(
+        default_factory=lambda: {"default": 1, "batch": 2}
+    )
+    # (gang size, weight) mix; min_member == size (full gangs).
+    gang_sizes: Sequence[Tuple[int, float]] = (
+        (1, 0.45), (2, 0.25), (4, 0.2), (8, 0.1)
+    )
+    # (cpu_m, mem_mi, weight) per-task request mix.
+    reqs: Sequence[Tuple[int, int, float]] = (
+        (500, 512, 0.6), (1000, 1024, 0.3), (2000, 2048, 0.1)
+    )
+    arrival_rate: float = 1.5       # expected job arrivals per cycle
+    duration_cycles: Tuple[int, int] = (4, 16)  # fully-running lifetime
+    max_jobs_in_flight: int = 64    # arrival back-pressure bound
+    # Planned churn: per-cycle probability of one node-add / node-drain
+    # event (drain deletes the node; its pods are killed and recreated
+    # as Pending by the harness — the replicaset-controller analog).
+    node_add_rate: float = 0.0
+    node_drain_rate: float = 0.0
+    min_nodes: int = 4
+    max_nodes: int = 64
+
+    def to_dict(self) -> dict:
+        return {
+            "nodes": self.nodes,
+            "node_cpu_m": self.node_cpu_m,
+            "node_mem_mi": self.node_mem_mi,
+            "queues": dict(self.queues),
+            "gang_sizes": [list(g) for g in self.gang_sizes],
+            "reqs": [list(r) for r in self.reqs],
+            "arrival_rate": self.arrival_rate,
+            "duration_cycles": list(self.duration_cycles),
+            "max_jobs_in_flight": self.max_jobs_in_flight,
+            "node_add_rate": self.node_add_rate,
+            "node_drain_rate": self.node_drain_rate,
+            "min_nodes": self.min_nodes,
+            "max_nodes": self.max_nodes,
+        }
+
+
+def _poisson(rng: random.Random, lam: float) -> int:
+    """Knuth inverse-transform Poisson sample off the seeded stream."""
+    if lam <= 0:
+        return 0
+    import math
+
+    limit = math.exp(-lam)
+    k, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1
+
+
+def _weighted(rng: random.Random, mix: Sequence[tuple]):
+    """Pick an entry from a (..., weight) mix."""
+    total = sum(m[-1] for m in mix)
+    x = rng.random() * total
+    for m in mix:
+        x -= m[-1]
+        if x <= 0:
+            return m
+    return mix[-1]
+
+
+class WorkloadGenerator:
+    """Per-cycle event emitter; the harness feeds back observed state
+    (which jobs are fully running, which nodes exist) through the
+    ``running_since`` / ``node_names`` arguments — both derived from
+    deterministic cluster state, so the feedback loop stays replayable."""
+
+    def __init__(self, spec: WorkloadSpec, seed: int):
+        self.spec = spec
+        self.rng = random.Random(f"{seed}/workload")
+        self._job_seq = 0
+        self._node_seq = spec.nodes
+        # name -> {"duration": d, "min_member": m}; jobs the generator
+        # considers alive (created, not yet deleted).
+        self.alive: Dict[str, dict] = {}
+        self._pending_delete: List[str] = []
+
+    # -- bootstrap -----------------------------------------------------------
+
+    def initial_events(self) -> List[dict]:
+        events = [
+            {"kind": "queue-add", "name": name, "weight": weight}
+            for name, weight in sorted(self.spec.queues.items())
+        ]
+        events.extend(
+            self._node_event(f"sim-node-{i:03d}")
+            for i in range(self.spec.nodes)
+        )
+        return events
+
+    def _node_event(self, name: str) -> dict:
+        return {
+            "kind": "node-add",
+            "name": name,
+            "cpu_m": self.spec.node_cpu_m,
+            "mem_mi": self.spec.node_mem_mi,
+        }
+
+    # -- per cycle -----------------------------------------------------------
+
+    def events_for_cycle(
+        self,
+        cycle: int,
+        running_since: Dict[str, int],
+        node_names: Sequence[str],
+    ) -> List[dict]:
+        spec, rng = self.spec, self.rng
+        events: List[dict] = []
+
+        # Deletions scheduled by last cycle's completions run first so
+        # the job's Succeeded pods leave before new arrivals land.
+        for name in self._pending_delete:
+            events.append({"kind": "job-delete", "name": name})
+            self.alive.pop(name, None)
+        self._pending_delete = []
+
+        # Completions: a job that has been fully running for its seeded
+        # duration succeeds now and is deleted next cycle (exercising
+        # the terminated-job cleanup path in between).
+        for name in sorted(self.alive):
+            since = running_since.get(name)
+            if since is None:
+                continue
+            if cycle - since >= self.alive[name]["duration"]:
+                events.append({"kind": "job-complete", "name": name})
+                self._pending_delete.append(name)
+
+        # Node churn (planned, seeded).
+        n_nodes = len(node_names)
+        if (
+            spec.node_add_rate > 0
+            and n_nodes < spec.max_nodes
+            and rng.random() < spec.node_add_rate
+        ):
+            name = f"sim-node-{self._node_seq:03d}"
+            self._node_seq += 1
+            events.append(self._node_event(name))
+        if (
+            spec.node_drain_rate > 0
+            and n_nodes > spec.min_nodes
+            and rng.random() < spec.node_drain_rate
+        ):
+            victim = rng.choice(sorted(node_names))
+            events.append(
+                {"kind": "node-remove", "name": victim, "reason": "drain"}
+            )
+
+        # Arrivals.
+        arrivals = _poisson(rng, spec.arrival_rate)
+        for _ in range(arrivals):
+            if len(self.alive) - len(self._pending_delete) >= (
+                spec.max_jobs_in_flight
+            ):
+                break
+            size = int(_weighted(rng, spec.gang_sizes)[0])
+            cpu_m, mem_mi, _ = _weighted(rng, spec.reqs)
+            queue = sorted(spec.queues)[
+                rng.randrange(len(spec.queues))
+            ]
+            duration = rng.randint(*spec.duration_cycles)
+            name = f"simjob-{self._job_seq:05d}"
+            self._job_seq += 1
+            self.alive[name] = {"duration": duration, "min_member": size}
+            events.append({
+                "kind": "job-create",
+                "name": name,
+                "queue": queue,
+                "replicas": size,
+                "min_member": size,
+                "cpu_m": int(cpu_m),
+                "mem_mi": int(mem_mi),
+                "duration": duration,
+            })
+        return events
+

@@ -80,6 +80,13 @@ class FakeKube:
         # Status document shaped like a real apiserver error to have the
         # request answered with it instead of being served.
         self.request_hook = None
+        # Bind-failure injection (the sim/fault-run seam, narrower than
+        # request_hook): callable (pod_key, hostname) -> None |
+        # (code, status_doc). A non-None return answers the Binding POST
+        # with that error WITHOUT mutating the pod — the scheduler's
+        # resync path must recover. Decisions should be pure functions
+        # of (pod, attempt) so a recorded run replays bit-identically.
+        self.bind_failure_hook = None
 
         fake = self
 
@@ -168,7 +175,15 @@ class FakeKube:
                     except (BrokenPipeError, ConnectionResetError):
                         return
                 with fake.lock:
-                    items = list(fake.objects[kind].values())
+                    # Sorted by key, NOT insertion order: list responses
+                    # must not depend on the interleaving of concurrent
+                    # creates, or a recorded scheduler run (whose cache
+                    # ingest order follows the initial list) would not
+                    # replay bit-identically against the same state.
+                    items = [
+                        fake.objects[kind][k]
+                        for k in sorted(fake.objects[kind])
+                    ]
                     rv = str(fake.rv)
                 if path.startswith("/api/v1"):
                     api_version = "v1"
@@ -202,6 +217,13 @@ class FakeKube:
                     parts = self.path.split("/")
                     ns, name = parts[4], parts[6]
                     hostname = body.get("target", {}).get("name", "")
+                    hook = fake.bind_failure_hook
+                    if hook is not None:
+                        injected = hook(f"{ns}/{name}", hostname)
+                        if injected is not None:
+                            code, doc = injected
+                            self._json(code, doc)
+                            return
                     with fake.lock:
                         pod = fake.objects["Pod"].get(f"{ns}/{name}")
                         if pod is None:

@@ -208,13 +208,19 @@ class TestPriority:
 class TestProportion:
     def test_weighted_queue_share(self):
         """'Proportion' (job.go:418): two queues split a full cluster by
-        weight (3:1 over 8 CPUs → 6 and 2)."""
-        with Context(nodes=2, node_cpu="4", node_mem="16Gi",
-                     queues={"q3": 3, "q1": 1}) as ctx:
-            ctx.create_and_submit(JobSpec(
-                name="j3", queue="q3", replicas=8, min_member=1))
-            ctx.create_and_submit(JobSpec(
-                name="j1", queue="q1", replicas=8, min_member=1))
+        weight (3:1 over 8 CPUs → 6 and 2). Both jobs are submitted
+        BEFORE the scheduler starts: the default policy has no reclaim
+        action, so if the first cycle lands between the two submissions
+        the earlier queue keeps the whole cluster forever — a race that
+        intermittently failed this test under full-suite load (arrival-
+        after-capacity is TestReclaim's subject, not this test's)."""
+        ctx = Context(nodes=2, node_cpu="4", node_mem="16Gi",
+                      queues={"q3": 3, "q1": 1})
+        ctx.create_and_submit(JobSpec(
+            name="j3", queue="q3", replicas=8, min_member=1))
+        ctx.create_and_submit(JobSpec(
+            name="j1", queue="q1", replicas=8, min_member=1))
+        with ctx:
             assert ctx.wait_tasks_ready("j3", 6)
             assert ctx.wait_tasks_ready("j1", 2)
             ctx.settle()

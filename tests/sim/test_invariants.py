@@ -1,0 +1,209 @@
+"""The invariant checker must CATCH seeded violations.
+
+Each test manufactures a corrupted (or contract-violating) cache state
+— some reachable only by bypassing the guarded accounting paths, which
+is the point: the checker is the independent auditor that notices when
+those guards ever fail over a long horizon.
+"""
+
+import pytest
+
+from kube_batch_tpu.api import (
+    PodPhase,
+    TaskInfo,
+    build_resource_list,
+    pod_key,
+)
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.sim.invariants import InvariantChecker, water_fill
+from kube_batch_tpu.utils.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+
+def make_cache():
+    return SchedulerCache(
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+
+
+def req(cpu="1", mem="1Gi"):
+    return build_resource_list(cpu=cpu, memory=mem)
+
+
+def add_running(cache, name, node, cpu="1", group=None):
+    pod = build_pod("sim", name, node, PodPhase.RUNNING, req(cpu),
+                    group_name=group)
+    cache.add_pod(pod)
+    return pod
+
+
+def kinds(violations):
+    return sorted({v.invariant for v in violations})
+
+
+class TestCleanState:
+    def test_healthy_cluster_has_no_violations(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_node(build_node("n1", req("4", "8Gi")))
+        c.add_pod_group(build_pod_group("g1", namespace="sim",
+                                        min_member=2))
+        add_running(c, "g1-0", "n1", group="g1")
+        add_running(c, "g1-1", "n1", group="g1")
+        checker = InvariantChecker()
+        assert checker.check(c, cycle=0) == []
+
+
+class TestOversubscribe:
+    def test_catches_node_over_allocatable(self):
+        c = make_cache()
+        c.add_node(build_node("n1", req("2", "4Gi")))
+        add_running(c, "p1", "n1", cpu="1500m")
+        # Corrupt: smuggle a second task past the accounting guard so
+        # the node holds 3 CPU against 2 allocatable.
+        rogue = TaskInfo(build_pod(
+            "sim", "p2", "n1", PodPhase.RUNNING, req("1500m")
+        ))
+        node = c.nodes["n1"]
+        node.tasks[pod_key(rogue.pod)] = rogue
+        checker = InvariantChecker()
+        found = checker.check(c, cycle=3)
+        assert "oversubscribe" in kinds(found)
+        assert any(v.subject == "n1" and v.cycle == 3 for v in found)
+
+    def test_catches_used_accounting_drift(self):
+        c = make_cache()
+        c.add_node(build_node("n1", req("4", "8Gi")))
+        add_running(c, "p1", "n1")
+        # Drift the maintained aggregate away from the task recount.
+        c.nodes["n1"].used.milli_cpu += 700
+        found = InvariantChecker().check(c, cycle=0)
+        assert "oversubscribe" in kinds(found)
+
+
+class TestGangAtomicity:
+    def _split_gang(self):
+        c = make_cache()
+        c.add_node(build_node("n1", req("8", "16Gi")))
+        c.add_pod_group(build_pod_group("g1", namespace="sim",
+                                        min_member=4))
+        add_running(c, "g1-0", "n1", group="g1")
+        add_running(c, "g1-1", "n1", group="g1")
+        for i in (2, 3):
+            c.add_pod(build_pod("sim", f"g1-{i}", "", PodPhase.PENDING,
+                                req(), group_name="g1"))
+        return c
+
+    def test_catches_partially_dispatched_gang(self):
+        c = self._split_gang()
+        found = InvariantChecker().check(c, cycle=1)
+        assert kinds(found) == ["gang"]
+        assert found[0].subject == "sim/g1"
+
+    def test_fault_degraded_gang_is_exempt_until_whole(self):
+        c = self._split_gang()
+        checker = InvariantChecker()
+        checker.mark_degraded("sim/g1", cycle=0)
+        assert checker.check(c, cycle=1) == []
+        # Made whole again (the pending pods get bound) -> exemption
+        # expires...
+        for i in (2, 3):
+            bound = build_pod("sim", f"g1-{i}", "n1", PodPhase.RUNNING,
+                              req(), group_name="g1")
+            c.update_pod(bound, bound)
+        assert checker.check(c, cycle=2) == []
+        assert "sim/g1" not in checker.degraded
+        # ...so a LATER split on the same gang is a violation again.
+        c.delete_pod(c.jobs["sim/g1"].tasks["sim-g1-3"].pod)
+        c.delete_pod(c.jobs["sim/g1"].tasks["sim-g1-2"].pod)
+        found = checker.check(c, cycle=3)
+        assert kinds(found) == ["gang"]
+
+
+class TestConservation:
+    def test_catches_double_bind(self):
+        c = make_cache()
+        c.add_node(build_node("n1", req("4", "8Gi")))
+        c.add_node(build_node("n2", req("4", "8Gi")))
+        pod = add_running(c, "p1", "n1")
+        # Corrupt: the same task accounted on a second node.
+        ghost = TaskInfo(pod)
+        c.nodes["n2"].tasks[pod_key(pod)] = ghost
+        found = InvariantChecker().check(c, cycle=0)
+        assert "conservation" in kinds(found)
+        assert any("double-bind" in v.message for v in found)
+
+    def test_catches_resource_holder_missing_from_node(self):
+        c = make_cache()
+        c.add_node(build_node("n1", req("4", "8Gi")))
+        pod = add_running(c, "p1", "n1")
+        # Corrupt: node forgot the task but the job still holds it.
+        del c.nodes["n1"].tasks[pod_key(pod)]
+        found = InvariantChecker().check(c, cycle=0)
+        assert "conservation" in kinds(found)
+        assert any("missing from its node" in v.message for v in found)
+
+    def test_catches_pending_task_holding_node_capacity(self):
+        c = make_cache()
+        c.add_node(build_node("n1", req("4", "8Gi")))
+        pending = TaskInfo(build_pod("sim", "p1", "", PodPhase.PENDING,
+                                     req()))
+        c.add_pod(pending.pod)
+        c.nodes["n1"].tasks[pod_key(pending.pod)] = pending
+        found = InvariantChecker().check(c, cycle=0)
+        assert any(
+            "PENDING task still accounted" in v.message for v in found
+        )
+
+
+class TestQueueShares:
+    def test_water_fill_matches_weighted_split(self):
+        from kube_batch_tpu.api import Resource
+
+        total = Resource(milli_cpu=9000)
+        deserved = water_fill(
+            total,
+            {"a": 2, "b": 1},
+            {"a": Resource(milli_cpu=9000),
+             "b": Resource(milli_cpu=9000)},
+        )
+        assert deserved["a"].milli_cpu == pytest.approx(6000)
+        assert deserved["b"].milli_cpu == pytest.approx(3000)
+
+    def test_catches_new_allocation_beyond_deserved(self):
+        c = make_cache()
+        c.add_queue(build_queue("qa", weight=1))
+        c.add_queue(build_queue("qb", weight=1))
+        c.add_node(build_node("n1", req("10", "100Gi")))
+        # qa: eight 1-CPU singletons running; qb: equal pending demand.
+        for i in range(8):
+            c.add_pod_group(build_pod_group(f"a{i}", namespace="sim",
+                                            min_member=1, queue="qa"))
+            add_running(c, f"a{i}-0", "n1", group=f"a{i}")
+        c.add_pod_group(build_pod_group("b0", namespace="sim",
+                                        min_member=8, queue="qb"))
+        for i in range(8):
+            c.add_pod(build_pod("sim", f"b0-{i}", "", PodPhase.PENDING,
+                                req(), group_name="b0"))
+        checker = InvariantChecker()
+        # Baseline pass records per-queue allocation, flags nothing.
+        assert checker.check(c, cycle=0) == []
+        # qa GAINS another singleton while already far past its
+        # deserved half -> the fairness contract is broken.
+        c.add_pod_group(build_pod_group("a9", namespace="sim",
+                                        min_member=1, queue="qa"))
+        add_running(c, "a9-0", "n1", group="a9")
+        found = checker.check(c, cycle=1)
+        assert kinds(found) == ["queue-share"]
+        assert found[0].subject == "qa"

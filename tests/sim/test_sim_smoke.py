@@ -1,0 +1,121 @@
+"""Tier-1 sim smoke: long-horizon invariants + replay/backend parity.
+
+The acceptance contract of the simulator subsystem:
+- a 200-cycle seeded run with bind-failure and node-flap injection
+  completes with ZERO invariant violations;
+- replaying a recorded trace reproduces identical per-cycle placements
+  (bit-determinism);
+- the same trace under the sparse solver at K >= N matches dense
+  exactly; the native backend matches per-job/total placement counts.
+"""
+
+import json
+
+import pytest
+
+from kube_batch_tpu.sim import SimConfig, TraceReader, WorkloadSpec
+from kube_batch_tpu.sim.harness import run_sim
+from kube_batch_tpu.sim.trace import diff_placements, placement_counts
+
+SMOKE_FAULTS = "bind:0.05,node-flap:0.02"
+
+
+def small_workload(**kw):
+    return WorkloadSpec(nodes=10, arrival_rate=1.2, **kw)
+
+
+class TestSimSmoke:
+    def test_200_cycle_fault_run_holds_all_invariants(self):
+        report, trace = run_sim(SimConfig(
+            cycles=200,
+            seed=7,
+            faults=SMOKE_FAULTS,
+            workload=small_workload(),
+            backend="dense",
+        ))
+        assert report.violations == []
+        assert report.cycle_errors == 0
+        assert report.cycles == 200
+        # The run must have actually exercised the machinery: work
+        # placed, churn completed, and faults genuinely injected.
+        assert report.placements > 100
+        assert report.jobs_completed > 20
+        assert report.bind_failures > 0
+        assert report.fault_counts.get("node-flap", 0) >= 1
+        # Trace shape: header + one record per cycle.
+        assert len(trace) == 201
+        assert trace[0]["type"] == "header"
+
+    def test_replay_is_bit_deterministic_and_backends_agree(self):
+        w = small_workload()
+        report_d, trace_d = run_sim(SimConfig(
+            cycles=60, seed=5, faults=SMOKE_FAULTS, workload=w,
+            backend="dense",
+        ))
+        assert report_d.violations == []
+        assert report_d.placements > 0
+
+        # Replay (same dense backend): every cycle record — events,
+        # faults, placements, stats — must be byte-identical.
+        report_r, trace_r = run_sim(SimConfig(
+            backend="dense", replay=TraceReader(trace_d),
+        ))
+        assert report_r.replay_mismatches == []
+        assert report_r.violations == []
+        assert [json.dumps(r, sort_keys=True) for r in trace_d[1:]] == [
+            json.dumps(r, sort_keys=True) for r in trace_r[1:]
+        ]
+
+        # Sparse solver at K >= N (10 nodes, K=16): bit-equal
+        # placements per cycle.
+        report_s, trace_s = run_sim(SimConfig(
+            backend="sparse", topk=16, replay=TraceReader(trace_d),
+        ))
+        assert report_s.replay_mismatches == []
+        assert report_s.violations == []
+        assert diff_placements(trace_d[1:], trace_s[1:]) == []
+
+        # Native backend: tie-breaking differs, but per-job and total
+        # placement counts must agree over the whole horizon. Compared
+        # on a bind-fault-only trace: bind failures are decided by a
+        # pure (pod, attempt) hash, so they are placement-independent —
+        # node-kill faults are not (a different backend puts different
+        # pods on the killed node), and comparing counts across
+        # backends there would couple this test to solver tie-breaking.
+        from kube_batch_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        report_b, trace_b = run_sim(SimConfig(
+            cycles=60, seed=5, faults="bind:0.05", workload=w,
+            backend="dense",
+        ))
+        assert report_b.violations == []
+        report_n, trace_n = run_sim(SimConfig(
+            backend="native", replay=TraceReader(trace_b),
+        ))
+        assert report_n.violations == []
+        assert placement_counts(trace_n[1:]) == placement_counts(
+            trace_b[1:]
+        )
+
+    def test_sim_cli_records_trace(self, tmp_path):
+        from kube_batch_tpu.sim.cli import main as sim_main
+
+        trace_path = tmp_path / "run.jsonl"
+        rc = sim_main([
+            "--cycles", "10", "--seed", "3", "--backend", "dense",
+            "--faults", "bind:0.1",
+            "--trace", str(trace_path), "--quiet",
+        ])
+        assert rc == 0
+        records = [
+            json.loads(line) for line in trace_path.read_text().splitlines()
+        ]
+        assert records[0]["type"] == "header"
+        assert [r["cycle"] for r in records[1:]] == list(range(10))
+        # And the recorded file replays clean through the CLI too.
+        rc = sim_main([
+            "--replay", str(trace_path), "--backend", "dense", "--quiet",
+        ])
+        assert rc == 0

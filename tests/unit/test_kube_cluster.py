@@ -739,3 +739,41 @@ class TestKubeVolumeCapability:
         assert fake.bindings == []
         assert cluster._claim_assumed == {}
         stop.set(); cluster.stop(); cache.shutdown()
+
+
+class TestFakeKubeDeterminism:
+    """The sim-replay contract on the HTTP fake: injectable bind
+    failures + list ordering independent of creation interleavings."""
+
+    def test_bind_failure_hook_rejects_without_binding(self, fake):
+        fake.create("Pod", pod_doc("p1"))
+        failed = []
+
+        def hook(pod_key, hostname):
+            failed.append((pod_key, hostname))
+            return 500, {"kind": "Status", "code": 500,
+                         "reason": "InternalError"}
+
+        fake.bind_failure_hook = hook
+        cluster = make_cluster(fake)
+        pod = cluster.list_objects("Pod")[0]
+        with pytest.raises(Exception):
+            cluster.bind_pod(pod, "n1")
+        assert failed == [("default/p1", "n1")]
+        assert fake.bindings == []
+        with fake.lock:
+            stored = fake.objects["Pod"]["default/p1"]
+        assert "nodeName" not in stored["spec"]
+        # Hook cleared -> the same bind succeeds (resync-path recovery).
+        fake.bind_failure_hook = None
+        cluster.bind_pod(pod, "n1")
+        assert fake.bindings == [("default/p1", "n1")]
+
+    def test_list_order_is_sorted_not_insertion(self, fake):
+        # Created out of order: the list response must come back sorted
+        # by key so a replayed run ingests identically.
+        for name in ("p3", "p1", "p2"):
+            fake.create("Pod", pod_doc(name))
+        cluster = make_cluster(fake)
+        names = [p.metadata.name for p in cluster.list_objects("Pod")]
+        assert names == ["p1", "p2", "p3"]

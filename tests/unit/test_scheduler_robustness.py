@@ -1,0 +1,80 @@
+"""Scheduler guarded-cycle robustness: a failing cycle must not kill
+the loop — it is logged, counted (``scheduler_cycle_errors_total``),
+and retried with capped exponential backoff."""
+
+import threading
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.sim.clock import VirtualClock
+from kube_batch_tpu.utils.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+)
+
+
+def make_scheduler(clock=None):
+    cache = SchedulerCache(
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+    return Scheduler(cache, schedule_period=0.01, clock=clock)
+
+
+class TestGuardedCycle:
+    def test_errors_counted_and_backoff_caps(self):
+        s = make_scheduler()
+        s.run_once = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        before = metrics.metrics.scheduler_cycle_errors.get()
+        assert s.cycle_error_backoff() == 0.0
+        seen = []
+        for _ in range(12):
+            assert s.run_once_guarded() is False
+            seen.append(s.cycle_error_backoff())
+        assert metrics.metrics.scheduler_cycle_errors.get() == before + 12
+        # 0.5, 1, 2, 4, ... capped at CYCLE_ERROR_BACKOFF_MAX.
+        assert seen[0] == Scheduler.CYCLE_ERROR_BACKOFF_BASE
+        assert seen[1] == 2 * seen[0]
+        assert seen[-1] == Scheduler.CYCLE_ERROR_BACKOFF_MAX
+        assert max(seen) == Scheduler.CYCLE_ERROR_BACKOFF_MAX
+
+    def test_success_resets_streak(self):
+        s = make_scheduler()
+        s.run_once = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        for _ in range(3):
+            s.run_once_guarded()
+        assert s.cycle_error_backoff() > 0
+        s.run_once = lambda: None
+        assert s.run_once_guarded() is True
+        assert s.cycle_error_backoff() == 0.0
+
+    def test_run_loop_survives_failing_cycles(self):
+        """The loop keeps going through a crash streak (on a virtual
+        clock, so the exponential backoffs cost no wall time) and still
+        runs healthy cycles afterwards."""
+        clock = VirtualClock()
+        s = make_scheduler(clock=clock)
+        stop = threading.Event()
+        calls = []
+
+        def flaky():
+            calls.append(clock.now())
+            if len(calls) <= 4:
+                raise RuntimeError("injected cycle failure")
+            if len(calls) >= 7:
+                stop.set()
+
+        s.run_once = flaky
+        t = threading.Thread(target=s.run, args=(stop,), daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert len(calls) >= 7
+        # Virtual time advanced through the backoffs: 0.5+1+2+4 from
+        # the error streak alone.
+        assert clock.now() >= 7.5
