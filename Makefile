@@ -61,10 +61,15 @@ sim-smoke:
 
 # Static checks (reference verify: gofmt/goimports/golint,
 # Makefile:13-17): byte-compile + the AST lint (unused/duplicate
-# imports, star imports, syntax).
+# imports, star imports, syntax) + the metrics census drift guard
+# (doc/design/metrics.md must match metrics.REGISTRY exactly, both
+# directions — it also runs with the full suite, but verify fails it
+# fast and first in `make ci`).
 verify:
 	$(PY) -m compileall -q kube_batch_tpu tests bench.py __graft_entry__.py
 	$(PY) tools/lint.py
+	env $(CPU_ENV) $(PY) -m pytest tests/unit/test_metrics_census.py -q \
+		-p no:cacheprovider
 
 # The exact CI pipeline (.github/workflows/ci.yml), runnable locally:
 # verify -> native -> test -> perf smoke -> bench smoke

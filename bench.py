@@ -350,7 +350,8 @@ def bench_tpu(cfg, seed=0, repeats=3):
     }
 
 
-def bench_cycle(cfg, seed=0, cache=None):
+def bench_cycle(cfg, seed=0, cache=None, trace_path=None,
+                measure_obs=False):
     """Full scheduling cycles through the production allocate_tpu action —
     the number BASELINE.md's <100 ms target is really about (the reference
     hot path is the whole runOnce, scheduler.go:88-103, not the inner
@@ -371,8 +372,16 @@ def bench_cycle(cfg, seed=0, cache=None):
     ``tensorize_incremental`` / ``tensorize_dirty_nodes`` /
     ``tensorize_full_reason`` (incremental snapshot patching and the
     row counts it actually touched).
+
+    With ``trace_path`` the span tracer records the four cycles and
+    exports one Chrome trace-event file (the acceptance artifact: the
+    cold cycle's solve/apply overlap shows as concurrent tracks in
+    Perfetto). ``measure_obs`` appends an ``obs`` section: tracer
+    overhead measured on/off over repeated idle-shape cycles at this
+    config, plus span counts per cycle.
     """
     from kube_batch_tpu.actions import allocate_tpu as _atpu
+    from kube_batch_tpu.obs.tracer import TRACER
 
     n_tasks, n_nodes, n_queues, n_groups = CONFIGS[cfg]
     if cache is None:
@@ -395,13 +404,18 @@ def bench_cycle(cfg, seed=0, cache=None):
             clear_pod_caches(t.pod for t in job.tasks.values())
     action, _ = get_action("allocate_tpu")
 
+    cycle_counter = [0]
+
     def one_cycle():
         # Same GC deferral as the production Scheduler.run_once: the
         # collection runs after t_close, in what would be think-time.
+        from kube_batch_tpu.obs import span
         from kube_batch_tpu.utils import deferred_gc
 
+        TRACER.begin_cycle(cycle_counter[0])
+        cycle_counter[0] += 1
         t_start = time.perf_counter()
-        with deferred_gc():
+        with span("cycle"), deferred_gc():
             ssn = open_session(cache, make_tiers(*TIERS_ARGS))
             t_open = time.perf_counter()
             action.execute(ssn)
@@ -412,7 +426,9 @@ def bench_cycle(cfg, seed=0, cache=None):
             "open_ms": round((t_open - t_start) * 1e3, 1),
             "action_ms": round((t_exec - t_open) * 1e3, 1),
             "close_ms": round((t_close - t_exec) * 1e3, 1),
-            "cycle_ms": round((t_close - t_start) * 1e3, 1),
+            # 3 decimals: the obs section's tracer-overhead comparison
+            # needs sub-0.1ms resolution on idle cycles.
+            "cycle_ms": round((t_close - t_start) * 1e3, 3),
             # close_session now runs under its own (nested) deferred_gc
             # guard, so a generational collection can never land inside
             # the close and jitter close_ms (r5: 2.1 -> 17.7 ms spikes).
@@ -426,9 +442,24 @@ def bench_cycle(cfg, seed=0, cache=None):
         out["drain_ok"] = cache.wait_for_side_effects(timeout=120.0)
         return out
 
+    tracing = trace_path is not None
+    if tracing:
+        TRACER.reset()
+        TRACER.enable()
+
+    def spans_since(mark):
+        return TRACER.spans_recorded - mark
+
+    mark = TRACER.spans_recorded
     cold = one_cycle()
+    cold["spans"] = spans_since(mark)
+    mark = TRACER.spans_recorded
     steady = one_cycle()
+    steady["spans"] = spans_since(mark)
+    mark = TRACER.spans_recorded
     idle = one_cycle()
+    idle["spans"] = spans_since(mark)
+    mark = TRACER.spans_recorded
 
     # ~1% new gangs arrive, drawn from the same shape mix as build_cluster.
     rng = np.random.RandomState(seed + 1)
@@ -451,8 +482,84 @@ def bench_cycle(cfg, seed=0, cache=None):
                 group_name=name,
             ))
     delta = one_cycle()
+    delta["spans"] = spans_since(mark)
+    out = {"cold": cold, "steady": steady, "idle": idle, "delta": delta}
+    if tracing:
+        out["trace_path"] = TRACER.export(trace_path)
+        out["trace_spans"] = TRACER.spans_recorded
+        out["trace_spans_dropped"] = TRACER.dropped
+        TRACER.disable()
+    if measure_obs:
+        out["obs"] = bench_obs(one_cycle)
     cache.shutdown()
-    return {"cold": cold, "steady": steady, "idle": idle, "delta": delta}
+    return out
+
+
+def bench_obs(one_cycle, runs=7):
+    """Tracer overhead at the benched shape.
+
+    Two measurements, because cycle-to-cycle wall-time variance at 50k
+    scale (GC, allocator state) is orders of magnitude larger than the
+    microseconds a handful of spans cost:
+
+    - **pinned overhead** = measured per-span cost (tight microbench of
+      the enabled span path) x spans recorded per cycle, as a fraction
+      of the tracer-OFF cycle median — deterministic, this is the
+      number the <1%-of-an-idle-cycle budget is checked against;
+    - **a/b delta** = interleaved off/on cycle medians, reported as
+      corroborating evidence (expected to sit inside run noise).
+    """
+    from kube_batch_tpu.obs.tracer import TRACER
+
+    was_enabled = TRACER.enabled
+    TRACER.disable()
+    one_cycle()  # settle after the caller's last cycle
+    off, on = [], []
+    span_count = 0
+    # Interleaved a/b so slow drift (cache warmth, GC pressure) hits
+    # both arms equally.
+    for _ in range(runs):
+        TRACER.disable()
+        off.append(one_cycle()["cycle_ms"])
+        TRACER.enable()
+        mark = TRACER.spans_recorded
+        on.append(one_cycle()["cycle_ms"])
+        span_count += TRACER.spans_recorded - mark
+    off.sort()
+    on.sort()
+    off_ms = off[len(off) // 2]
+    on_ms = on[len(on) // 2]
+    spans_per_cycle = span_count / float(runs)
+
+    # Deterministic per-span cost of the ENABLED recording path.
+    probe_n = 20_000
+    TRACER.reset()
+    TRACER.enable()
+    t0 = time.perf_counter()
+    for _ in range(probe_n):
+        with TRACER.span("obs-probe"):
+            pass
+    span_cost_us = (time.perf_counter() - t0) / probe_n * 1e6
+    TRACER.reset()
+    TRACER.enabled = was_enabled
+
+    overhead_ms = spans_per_cycle * span_cost_us / 1e3
+    delta_ms = max(0.0, on_ms - off_ms)
+    return {
+        "idle_cycle_off_ms": round(off_ms, 3),
+        "idle_cycle_on_ms": round(on_ms, 3),
+        "spans_per_cycle": round(spans_per_cycle, 1),
+        "span_cost_us": round(span_cost_us, 2),
+        "tracer_overhead_ms": round(overhead_ms, 4),
+        "tracer_overhead_pct": (
+            round(overhead_ms / off_ms * 100.0, 3) if off_ms else 0.0
+        ),
+        "ab_delta_ms": round(delta_ms, 3),
+        "ab_delta_pct": (
+            round(delta_ms / off_ms * 100.0, 2) if off_ms else 0.0
+        ),
+        "runs": runs,
+    }
 
 
 def bench_device_cache(cfg="small", seed=0):
@@ -728,6 +835,11 @@ def main():
         help="extra sparse-only scale point (e.g. 200000x20000); the "
              "default large run includes 200000x20000 automatically",
     )
+    ap.add_argument(
+        "--trace", metavar="PATH", default=None,
+        help="export one Chrome trace-event JSON of the benched "
+             "production cycles to PATH (open in Perfetto)",
+    )
     args = ap.parse_args()
     _ensure_live_backend(require_accelerator=args.require_accelerator)
     if args.smoke:
@@ -850,9 +962,13 @@ def main():
     # Guarded: a crash/hang here must not lose the already-measured headline
     # (round-1 lesson — a bench that dies records nothing).
     try:
-        cycle = bench_cycle(headline_cfg, cache=tpu["cache"])
+        cycle = bench_cycle(
+            headline_cfg, cache=tpu["cache"], trace_path=args.trace,
+            measure_obs=True,
+        )
     except Exception as exc:  # pragma: no cover - defensive
         cycle = {"error": f"{type(exc).__name__}: {exc}"}
+    obs = cycle.pop("obs", None) if isinstance(cycle, dict) else None
 
     # Device-resident snapshot pack stats (small config: the mechanics,
     # not the scale — the headline cycles carry device_* keys whenever
@@ -906,6 +1022,7 @@ def main():
         "device": str(jax.devices()[0].platform),
         "device_provenance": provenance,
         "cycle": cycle,
+        "obs": obs,
         "device_cache": device_cache,
         "solver_sparse": tpu["sparse"],
         "sim": sim,

@@ -32,6 +32,8 @@ import numpy as np
 from .. import metrics
 from ..api import Resource
 from ..framework import Action, register_action
+from ..obs import RECORDER, span
+from ..obs.tracer import TRACER
 from ..solver import solve_sharded, tensorize
 from ..utils.scheduler_helper import prioritize_nodes, select_best_node
 
@@ -148,9 +150,16 @@ class AsyncSolveHandle:
             handle = cls("native")
             from ..native import solve_native
 
-            handle._future = _native_executor().submit(
-                solve_native, inputs
-            )
+            # Worker-thread span adopted under the launching span: the
+            # exported trace shows the C++ rounds as a concurrent track
+            # nested under this cycle.
+            parent = TRACER.capture()
+
+            def traced_solve():
+                with TRACER.adopt(parent), span("native_solve"):
+                    return solve_native(inputs)
+
+            handle._future = _native_executor().submit(traced_solve)
             return handle
         import jax
 
@@ -247,7 +256,8 @@ class AllocateTpuAction(Action):
         # shuttling data through JAX for a solve that runs in C++.
         use_native = _use_native_solver()
         t0 = time.perf_counter()
-        inputs, ctx = tensorize(ssn, device=not use_native)
+        with span("tensorize"):
+            inputs, ctx = tensorize(ssn, device=not use_native)
         _record_phase("tensorize", (time.perf_counter() - t0) * 1e3)
         # Incremental-tensorize forensics (dirty-row counts, fallback
         # reasons) for the bench/BENCH attribution.
@@ -256,6 +266,16 @@ class AllocateTpuAction(Action):
         for k, v in last_tensorize_stats.items():
             last_stats[f"tensorize_{k}"] = v
         if inputs is None:
+            # Idle cycle: nothing to solve, but verdicts recorded on
+            # earlier cycles must not outlive the jobs they describe
+            # (the reason gauge and /debug/jobs GC live in the verdict
+            # pass, which only runs after a real solve).
+            try:
+                from ..obs import explain
+
+                explain.record_idle_cycle(ssn)
+            except Exception:  # pragma: no cover - forensics only
+                logger.exception("idle-cycle verdict GC failed")
             return
 
         t0 = time.perf_counter()
@@ -263,9 +283,10 @@ class AllocateTpuAction(Action):
         # dispatch, native rounds on a GIL-releasing worker thread);
         # the window below runs host work that does not depend on the
         # assignment, and handle.fetch() is the single block point.
-        handle = AsyncSolveHandle.launch(
-            inputs, use_native, self.max_rounds
-        )
+        with span("solve_dispatch", jax_annotate=True):
+            handle = AsyncSolveHandle.launch(
+                inputs, use_native, self.max_rounds
+            )
         ssn.register_inflight_solve(handle)
         t_launch = time.perf_counter()
         last_stats["solve_launch_ms"] = (t_launch - t0) * 1e3
@@ -283,24 +304,27 @@ class AllocateTpuAction(Action):
                     last_stats[f"device_{k}"] = v
         # Epilogue prep: the Releasing-capacity candidate scan reads
         # only the snapshot, never the assignment.
-        releasing_nodes = self._releasing_candidates(ssn, ctx)
-        if not handle.done():
-            # The previous cycle's async bind/evict side effects drain
-            # on their worker threads; parking here (bounded) yields
-            # the GIL to them inside the solve's shadow instead of
-            # letting the backlog contend with the apply phase.
-            # Bool: did the previous cycle's bind queue fully drain
-            # inside the overlap window (vs the bounded wait timing
-            # out with backlog left).
-            last_stats["overlap_binds_drained"] = (
-                ssn.cache.wait_for_side_effects(timeout=0.02)
-            )
+        with span("overlap_window"):
+            releasing_nodes = self._releasing_candidates(ssn, ctx)
+            if not handle.done():
+                # The previous cycle's async bind/evict side effects
+                # drain on their worker threads; parking here (bounded)
+                # yields the GIL to them inside the solve's shadow
+                # instead of letting the backlog contend with the apply
+                # phase. Bool: did the previous cycle's bind queue
+                # fully drain inside the overlap window (vs the bounded
+                # wait timing out with backlog left).
+                with span("bind_drain"):
+                    last_stats["overlap_binds_drained"] = (
+                        ssn.cache.wait_for_side_effects(timeout=0.02)
+                    )
         last_stats["overlap_ms"] = (
             time.perf_counter() - t_launch
         ) * 1e3
 
         t_block = time.perf_counter()
-        assigned = handle.fetch()
+        with span("solve_block", jax_annotate=True):
+            assigned = handle.fetch()
         ssn.register_inflight_solve(None)
         rounds, backend = handle.rounds, handle.backend
         metrics.update_solver_cycle(rounds, backend)
@@ -474,6 +498,7 @@ class AllocateTpuAction(Action):
                     )
 
         _record_phase("apply", (time.perf_counter() - t0) * 1e3)
+        TRACER.complete("apply", t0)
         last_stats["placed"] = placed
         # Apply sub-phase forensics from the batched session path.
         from ..framework.session import last_apply_stats
@@ -523,6 +548,57 @@ class AllocateTpuAction(Action):
                 )
 
         _record_phase("epilogue", (time.perf_counter() - t0) * 1e3)
+        TRACER.complete("epilogue", t0)
+
+        # --- explainability + flight-recorder attribution --------------
+        # Per-job verdicts for everything the solve left unassigned
+        # (obs/explain.py), classified from the cycle's own evidence —
+        # cost scales with the unassigned count. The flight recorder's
+        # open cycle record absorbs the cycle's solver attribution so
+        # an error/SIGUSR1 dump carries it without re-deriving.
+        t0 = time.perf_counter()
+        with span("verdicts"):
+            try:
+                from ..obs import explain
+
+                # "exhausted" = the sparse solve reported pressure past
+                # its truncated slabs (native per-task scan-overflow
+                # fallbacks). Truncation ALONE is normal and both
+                # backends refill to exact verdicts — see
+                # explain._classify.
+                ns = handle.native_stats or {}
+                sparse_info = {
+                    "engaged": engaged,
+                    "k": tsparse.get("k"),
+                    "truncated": bool(tsparse.get("truncated_classes")),
+                    "exhausted": bool(
+                        engaged and ns.get("fallback_scans", 0)
+                    ),
+                    "refill_rounds": refill_rounds,
+                    "fallback_reason": fallback_reason,
+                }
+                reason_counts = explain.record_cycle_verdicts(
+                    ssn, ctx, assigned, sparse=sparse_info
+                )
+                if reason_counts:
+                    last_stats["unschedulable_reasons"] = reason_counts
+            except Exception:  # pragma: no cover - forensics only
+                logger.exception("verdict recording failed")
+                reason_counts = {}
+        last_stats["verdicts_ms"] = (time.perf_counter() - t0) * 1e3
+        RECORDER.annotate("solver", {
+            "backend": backend,
+            "rounds": rounds,
+            "placed": placed,
+            "tasks": len(ctx.tasks),
+            "sparse_engaged": engaged,
+            "sparse_k": tsparse.get("k") if engaged else None,
+            "sparse_refill_rounds": refill_rounds if engaged else None,
+            "fallback_reason": fallback_reason,
+            "device_bytes_shipped": last_stats.get("device_bytes_shipped"),
+            "device_rows_patched": last_stats.get("device_rows_patched"),
+            "unschedulable_reasons": reason_counts,
+        })
         logger.debug(
             "allocate_tpu placed %d/%d tasks in %d rounds",
             placed, len(ctx.tasks), rounds,

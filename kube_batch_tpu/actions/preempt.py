@@ -17,6 +17,7 @@ import logging
 from .. import metrics
 from ..api import Resource, TaskStatus
 from ..framework import Action, register_action
+from ..obs import explain
 from ..utils import PriorityQueue
 from ..utils.scheduler_helper import (
     FeasibilityMemo,
@@ -41,8 +42,10 @@ def _validate_victims(victims, resreq: Resource) -> bool:
     return True
 
 
-def _preempt(ssn, stmt, preemptor, nodes, filter_fn, memo=None) -> bool:
-    """reference preempt.go:171-254"""
+def _preempt(ssn, stmt, preemptor, nodes, filter_fn, memo=None,
+             stats=None) -> bool:
+    """reference preempt.go:171-254. ``stats``, when given, accumulates
+    the attempt's victim count (explainability: obs/explain)."""
     assigned = False
     if memo is not None:
         # Cycle-scoped spec-keyed feasibility (same throughput reasoning
@@ -89,6 +92,8 @@ def _preempt(ssn, stmt, preemptor, nodes, filter_fn, memo=None) -> bool:
                 )
                 continue
             preempted.add(preemptee.resreq)
+            if stats is not None:
+                stats["victims"] = stats.get("victims", 0) + 1
             if resreq.less_equal(preempted):
                 break
 
@@ -145,6 +150,7 @@ class PreemptAction(Action):
 
                 stmt = ssn.statement()
                 assigned = False
+                stats = {"victims": 0}
                 while True:
                     if preemptor_tasks[preemptor_job.uid].empty():
                         break
@@ -161,13 +167,21 @@ class PreemptAction(Action):
                         )
 
                     if _preempt(ssn, stmt, preemptor, ssn.nodes,
-                                filter_fn, memo=memo):
+                                filter_fn, memo=memo, stats=stats):
                         assigned = True
                     if ssn.job_pipelined(preemptor_job):
                         stmt.commit()
                         break
 
-                if not ssn.job_pipelined(preemptor_job):
+                placed = ssn.job_pipelined(preemptor_job)
+                # Victim-selection outcome for the claimant's next
+                # unschedulable verdict (obs/explain): how many victims
+                # this attempt selected and whether the gang actually
+                # got pipelined (a discard rolls the evictions back).
+                explain.note_victim_outcome(
+                    preemptor_job.uid, "preempt", stats["victims"], placed
+                )
+                if not placed:
                     stmt.discard()
                     continue
                 if assigned:

@@ -13,6 +13,7 @@ import logging
 
 from ..api import Resource, TaskStatus
 from ..framework import Action, register_action
+from ..obs import explain
 from ..utils import PriorityQueue
 from ..utils.scheduler_helper import FeasibilityMemo
 
@@ -225,6 +226,7 @@ class ReclaimAction(Action):
                 continue
 
             assigned = False
+            victims_evicted = 0
             exhausted = no_victims.setdefault(job.queue, set())
             for node in feasible:
                 # Memo soundness: within a cycle, verdicts in the
@@ -305,6 +307,7 @@ class ReclaimAction(Action):
                     if resreq.less_equal(reclaimed):
                         break
                 evicted = ssn.evict_batch(chosen, "reclaim")
+                victims_evicted += len(evicted)
                 if len(evicted) != len(chosen):
                     reclaimed = Resource.empty()
                     for t in evicted:
@@ -329,6 +332,11 @@ class ReclaimAction(Action):
                     assigned = True
                     break
 
+            # Victim-selection outcome for the claimant's next
+            # unschedulable verdict (obs/explain).
+            explain.note_victim_outcome(
+                job.uid, "reclaim", victims_evicted, assigned
+            )
             if assigned:
                 queues.push(queue)
 

@@ -38,6 +38,7 @@ from ..api import (
     TaskInfo,
     TaskStatus,
 )
+from ..obs.tracer import TRACER, span as _obs_span
 from ..api.objects import DEFAULT_SCHEDULER_NAME
 from ..cluster import ADDED, DELETED, MODIFIED, ClusterAPI
 from .event_handlers import EventHandlersMixin
@@ -216,9 +217,22 @@ class SchedulerCache(Cache, EventHandlersMixin):
             if bookkeeping:
                 self._bookkeeping_inflight += 1
 
+        # Tracer handshake: side-effect spans adopt the submitting
+        # span's id, so async binds/evicts render as worker-pool tracks
+        # nested under the cycle that queued them.
+        traced = TRACER.enabled
+        parent = TRACER.capture() if traced else 0
+        span_name = (
+            "cache_bookkeeping" if bookkeeping else "cache_side_effect"
+        )
+
         def wrapped():
             try:
-                fn()
+                if traced:
+                    with TRACER.adopt(parent), _obs_span(span_name):
+                        fn()
+                else:
+                    fn()
             except Exception:
                 # A side-effect job's Future is never read, so an
                 # escaping exception would otherwise vanish — and for

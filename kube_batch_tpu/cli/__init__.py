@@ -58,6 +58,12 @@ def main(argv=None) -> None:
         from ..sim.cli import main as sim_main
 
         sys.exit(sim_main(args[1:]))
+    if args and args[0] == "explain":
+        # Subcommand: pending-gang explainability
+        # (`python -m kube_batch_tpu explain <ns>/<job>` — obs/explain).
+        from ..obs.explain import cli_main as explain_main
+
+        sys.exit(explain_main(args[1:]))
 
     opt = parse_options(argv)
     if opt.print_version:

@@ -21,7 +21,10 @@ from typing import Optional, Tuple
 from .. import metrics
 from ..cache import new_scheduler_cache
 from ..cluster import ClusterAPI, InProcessCluster
+from ..obs import RECORDER
+from ..obs import explain as obs_explain
 from ..scheduler import Scheduler
+from ..version import RELEASE_VERSION
 from .options import (
     LEASE_DURATION,
     RENEW_DEADLINE,
@@ -35,33 +38,104 @@ logger = logging.getLogger(__name__)
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
-    """Serves /metrics in Prometheus text exposition format plus /healthz
-    (reference server.go:86-89 promhttp handler)."""
+    """Serves /metrics (Prometheus text exposition, reference
+    server.go:86-89 promhttp handler) plus the observability surface:
 
-    def do_GET(self):  # noqa: N802 (http.server API)
-        if self.path.rstrip("/") in ("", "/healthz"):
-            body = b"ok\n"
-            ctype = "text/plain"
-        elif self.path.startswith("/metrics"):
-            body = metrics.REGISTRY.expose_text().encode()
-            ctype = "text/plain; version=0.0.4"
-        else:
-            self.send_response(404)
-            self.end_headers()
-            return
-        self.send_response(200)
+    - ``/healthz``: cheap liveness ("ok") — probes must not scrape the
+      full exposition;
+    - ``/debug/vars``: uptime, version, last-cycle age, cycle error
+      count as one small JSON object;
+    - ``/debug/flightrecorder``: the flight recorder's ring as
+      canonical JSON (obs/flightrecorder.py);
+    - ``/debug/jobs`` and ``/debug/jobs/<ns>/<name>``: per-job last
+      unschedulable verdicts (obs/explain.py).
+
+    Unknown paths get a 404 WITH a body naming the path — a silent
+    empty 404 reads like a transport bug from curl."""
+
+    def _reply(self, body, ctype="text/plain", code=200):
+        if isinstance(body, str):
+            body = body.encode()
+        self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
+    def _debug_vars(self) -> dict:
+        now = time.time()
+        last = RECORDER.last_cycle_ts
+        return {
+            "version": RELEASE_VERSION,
+            "pid": os.getpid(),
+            "uptime_seconds": round(now - _SERVER_STARTED[0], 3),
+            "last_cycle_age_seconds": (
+                round(now - last, 3) if last is not None else None
+            ),
+            "cycles_recorded": RECORDER._seq,
+            "cycle_errors": metrics.scheduler_cycle_errors.get(),
+            "unschedulable_jobs": len(obs_explain.all_verdicts()),
+        }
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path in ("", "/healthz"):
+            self._reply("ok\n")
+        elif path.startswith("/metrics"):
+            self._reply(
+                metrics.REGISTRY.expose_text(),
+                ctype="text/plain; version=0.0.4",
+            )
+        elif path == "/debug/vars":
+            self._reply(
+                json.dumps(self._debug_vars(), sort_keys=True) + "\n",
+                ctype="application/json",
+            )
+        elif path == "/debug/flightrecorder":
+            self._reply(
+                RECORDER.dump_json(reason="http") + "\n",
+                ctype="application/json",
+            )
+        elif path == "/debug/jobs":
+            payload = {
+                "jobs": [v.to_dict() for v in obs_explain.all_verdicts()]
+            }
+            self._reply(
+                json.dumps(payload, sort_keys=True) + "\n",
+                ctype="application/json",
+            )
+        elif path.startswith("/debug/jobs/"):
+            uid = path[len("/debug/jobs/"):]
+            verdict = obs_explain.get_verdict(uid)
+            if verdict is None:
+                self._reply(
+                    f"no unschedulable verdict recorded for job "
+                    f"{uid!r}\n",
+                    code=404,
+                )
+            else:
+                self._reply(
+                    json.dumps(
+                        {"verdict": verdict.to_dict()}, sort_keys=True
+                    ) + "\n",
+                    ctype="application/json",
+                )
+        else:
+            self._reply(f"404 page not found: {self.path}\n", code=404)
+
     def log_message(self, fmt, *args):
         logger.debug("metrics-http: " + fmt, *args)
+
+
+# Wall-clock epoch of the most recent start_metrics_server call (list so
+# the handler reads the live value; /debug/vars uptime).
+_SERVER_STARTED = [time.time()]
 
 
 def start_metrics_server(listen_address: str) -> Tuple[ThreadingHTTPServer, threading.Thread]:
     """Start the /metrics endpoint in a daemon thread; returns (server, thread)."""
     host, _, port = listen_address.rpartition(":")
+    _SERVER_STARTED[0] = time.time()
     server = ThreadingHTTPServer((host or "0.0.0.0", int(port)), _MetricsHandler)
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name="metrics-http")
@@ -304,6 +378,13 @@ def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
         logger.info("jax backend ready: %d device(s)", devices)
 
     http_server, _ = start_metrics_server(opt.listen_address)
+    # SIGUSR1 → flight-recorder dump. Installed HERE (cli.run is always
+    # on the main thread) as well as in Scheduler.run, because signal
+    # handlers cannot be installed from the worker thread an embedder
+    # may drive the loop on.
+    from ..obs import install_sigusr1
+
+    install_sigusr1()
     stop = stop_event or threading.Event()
 
     def run_scheduler(lost_leadership: Optional[threading.Event] = None):

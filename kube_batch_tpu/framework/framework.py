@@ -18,6 +18,7 @@ from typing import List
 
 from .. import metrics
 from ..conf import Tier
+from ..obs import span
 from .arguments import Arguments
 from .plugins import get_plugin_builder
 from .session import Session
@@ -38,12 +39,13 @@ def open_session(cache, tiers: List[Tier]) -> Session:
             plugin = builder(Arguments(opt.arguments))
             ssn.plugins[plugin.name()] = plugin
 
-    for plugin in ssn.plugins.values():
-        start = time.perf_counter()
-        plugin.on_session_open(ssn)
-        metrics.update_plugin_duration(
-            plugin.name(), "OnSessionOpen", time.perf_counter() - start
-        )
+    with span("plugins_open"):
+        for plugin in ssn.plugins.values():
+            start = time.perf_counter()
+            plugin.on_session_open(ssn)
+            metrics.update_plugin_duration(
+                plugin.name(), "OnSessionOpen", time.perf_counter() - start
+            )
 
     ssn._validate_jobs()
     return ssn
@@ -63,10 +65,12 @@ def close_session(ssn: Session) -> None:
     from ..utils import deferred_gc
 
     with deferred_gc():
-        for plugin in ssn.plugins.values():
-            start = time.perf_counter()
-            plugin.on_session_close(ssn)
-            metrics.update_plugin_duration(
-                plugin.name(), "OnSessionClose", time.perf_counter() - start
-            )
+        with span("plugins_close"):
+            for plugin in ssn.plugins.values():
+                start = time.perf_counter()
+                plugin.on_session_close(ssn)
+                metrics.update_plugin_duration(
+                    plugin.name(), "OnSessionClose",
+                    time.perf_counter() - start,
+                )
         ssn._close()

@@ -130,7 +130,10 @@ class Session:
 
     def _open(self) -> None:
         """reference session.go:63-117"""
-        snapshot = self.cache.snapshot()
+        from ..obs import span
+
+        with span("snapshot"):
+            snapshot = self.cache.snapshot()
         self.jobs = snapshot.jobs
         self.nodes = snapshot.nodes
         self.queues = snapshot.queues

@@ -145,6 +145,19 @@ class Registry:
         self._metrics.append((metric, label_names))
         return metric
 
+    def names(self) -> List[str]:
+        """Registered metric names WITHOUT the namespace prefix — the
+        census drift guard (tests/unit/test_metrics_census.py) compares
+        these against doc/design/metrics.md's tables."""
+        prefix = f"{NAMESPACE}_"
+        out = []
+        for metric, _labels in self._metrics:
+            name = metric.name
+            if name.startswith(prefix):
+                name = name[len(prefix):]
+            out.append(name)
+        return out
+
     def expose_text(self) -> str:
         lines: List[str] = []
         for metric, label_names in self._metrics:
@@ -319,6 +332,19 @@ sim_invariant_violations = REGISTRY.register(
     ),
     ("invariant",),
 )
+# Explainability (kube_batch_tpu/obs/explain.py): unassigned pending
+# tasks bucketed by the solver's last-cycle verdict, so a dashboard
+# can split "pending because predicates" from "pending because gang
+# threshold" without scraping /debug/jobs.
+unschedulable_tasks = REGISTRY.register(
+    Gauge(
+        "unschedulable_tasks",
+        "Unassigned pending tasks by last-cycle verdict reason "
+        "(predicate-blocked/queue-overused/refill-exhausted/"
+        "gang-minmember/no-fit)",
+    ),
+    ("reason",),
+)
 
 
 # Update helpers (reference metrics.go:122-170).
@@ -445,6 +471,18 @@ def update_solver_jit_cache(count: int) -> None:
 def register_cycle_error() -> None:
     """One scheduling cycle raised and was absorbed by the guarded loop."""
     scheduler_cycle_errors.inc()
+
+
+def update_unschedulable_reasons(counts: dict) -> None:
+    """Per-cycle unschedulable-task counts by verdict reason. Absent
+    reasons are zeroed so the gauge never carries a stale bucket."""
+    from ..obs.explain import ALL_REASONS
+
+    for reason in ALL_REASONS:
+        unschedulable_tasks.set(float(counts.get(reason, 0)), (reason,))
+    for reason in counts:
+        if reason not in ALL_REASONS:  # defensive: unknown classifier
+            unschedulable_tasks.set(float(counts[reason]), (reason,))
 
 
 def register_sim_cycle() -> None:

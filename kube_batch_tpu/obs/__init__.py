@@ -1,0 +1,32 @@
+"""Observability layer: span tracer, flight recorder, explainability.
+
+Three surfaces over the pipelined scheduling cycle (doc/design/
+observability.md):
+
+- ``tracer``: low-overhead hierarchical spans across the cycle's worker
+  threads, exported as Chrome trace-event JSON (``KBT_TRACE_DIR``, or
+  explicit :func:`export_trace` calls from bench/sim).
+- ``flightrecorder``: a fixed-size ring of per-cycle records (phase
+  timings, solver stats, verdict counts, errors with tracebacks),
+  dumped as canonical JSON on cycle error, SIGUSR1, and the metrics
+  server's ``/debug/flightrecorder`` endpoint.
+- ``explain``: structured per-job "last unschedulable reason" verdicts
+  (predicate-blocked / no-fit / gang minMember / truncated-slab refill
+  exhaustion / queue-overused / preempt-reclaim outcomes), behind the
+  ``tpu_batch_unschedulable_tasks`` metric, ``/debug/jobs/<ns>/<name>``
+  and ``python -m kube_batch_tpu explain``.
+"""
+
+from .flightrecorder import RECORDER, FlightRecorder, install_sigusr1
+from .tracer import TRACER, Tracer, export_trace, span, trace_dir_from_env
+
+__all__ = [
+    "RECORDER",
+    "FlightRecorder",
+    "TRACER",
+    "Tracer",
+    "export_trace",
+    "install_sigusr1",
+    "span",
+    "trace_dir_from_env",
+]

@@ -16,6 +16,8 @@ from typing import List, Optional, Tuple
 from . import metrics
 from .conf import DEFAULT_SCHEDULER_CONF, Tier, parse_scheduler_conf
 from .framework import Action, close_session, get_action, open_session
+from .obs import RECORDER, export_trace, span
+from .obs.tracer import TRACER, maybe_enable_from_env
 from .utils import deferred_gc
 
 logger = logging.getLogger(__name__)
@@ -81,6 +83,10 @@ class Scheduler:
         self.schedule_period = schedule_period
         self.clock = clock or _WallClock()
         self._error_streak = 0
+        self._cycle_count = 0
+        # KBT_TRACE_DIR arms the span tracer for the whole loop; the
+        # trace file is written on loop exit and on cycle errors.
+        maybe_enable_from_env()
         confstr = scheduler_conf or DEFAULT_SCHEDULER_CONF
         if "\n" not in confstr and confstr.endswith((".yaml", ".yml")):
             with open(confstr) as f:
@@ -96,9 +102,16 @@ class Scheduler:
         the production error path."""
         try:
             self.run_once()
-        except Exception:
+        except Exception as exc:
             self._error_streak += 1
             metrics.register_cycle_error()
+            # Flight-recorder forensics: the open cycle record absorbs
+            # the failing phase + traceback and is committed to the
+            # ring; a dump file lands in KBT_FLIGHT_DIR when set, and a
+            # Chrome trace alongside it when tracing is armed.
+            RECORDER.record_error(exc)
+            RECORDER.dump_on_error()
+            export_trace(tag="trace-cycle-error")
             logger.exception(
                 "scheduling cycle failed (streak %d, next backoff %.1fs)",
                 self._error_streak, self.cycle_error_backoff(),
@@ -118,8 +131,13 @@ class Scheduler:
 
     def run(self, stop_event: Optional[threading.Event] = None) -> None:
         """reference scheduler.go:63-85"""
+        from .obs import install_sigusr1
+
         stop = stop_event or threading.Event()
         clock = self.clock
+        # Live-process forensics: SIGUSR1 dumps the flight-recorder ring
+        # (no-op on non-main threads — the sim drives cycles directly).
+        install_sigusr1()
         self.cache.run(stop)
         self.cache.wait_for_cache_sync(stop)
         while not stop.is_set():
@@ -150,25 +168,64 @@ class Scheduler:
                     logger.exception("think-time side-effect drain failed")
                 remaining = max(0.0, deadline - time.perf_counter())
             clock.wait(stop, remaining)
+        # Loop exit with tracing armed (KBT_TRACE_DIR): persist the
+        # buffered spans so an operator-stopped run leaves a trace.
+        export_trace(tag="trace")
 
     def run_once(self) -> None:
         """One scheduling cycle (reference scheduler.go:88-103). GC is
         deferred for the cycle's duration — collections triggered by the
         apply phase's allocation burst otherwise stop the world mid-cycle
         (~350 ms at 50k tasks); the deferred collection runs in the
-        scheduler's think-time gap instead (utils/gc_guard.py)."""
+        scheduler's think-time gap instead (utils/gc_guard.py).
+
+        Instrumented end to end: every phase runs under a tracer span
+        and stamps the flight recorder's open cycle record, so an error
+        dump names the phase that raised and the Chrome trace shows the
+        phase timeline across the overlap window's worker threads."""
+        cycle = self._cycle_count
+        self._cycle_count += 1
+        TRACER.begin_cycle(cycle)
+        RECORDER.begin_cycle(cycle)
         cycle_start = time.perf_counter()
-        with deferred_gc():
-            ssn = open_session(self.cache, self.tiers)
-            try:
-                for action in self.actions:
-                    action_start = time.perf_counter()
-                    action.initialize()
-                    action.execute(ssn)
-                    action.un_initialize()
-                    metrics.update_action_duration(
-                        action.name(), time.perf_counter() - action_start
+        with span("cycle"):
+            with deferred_gc():
+                RECORDER.phase("open_session")
+                t0 = time.perf_counter()
+                with span("open_session"):
+                    ssn = open_session(self.cache, self.tiers)
+                RECORDER.phase_done(
+                    "open_session", (time.perf_counter() - t0) * 1e3
+                )
+                try:
+                    for action in self.actions:
+                        name = action.name()
+                        RECORDER.phase(f"action:{name}")
+                        action_start = time.perf_counter()
+                        with span(f"action:{name}"):
+                            action.initialize()
+                            action.execute(ssn)
+                            action.un_initialize()
+                        elapsed = time.perf_counter() - action_start
+                        metrics.update_action_duration(name, elapsed)
+                        RECORDER.phase_done(
+                            f"action:{name}", elapsed * 1e3
+                        )
+                except BaseException:
+                    # Pin the phase that actually raised before the
+                    # finally's close_session overwrites it — the error
+                    # dump must name the FAILING phase.
+                    RECORDER.mark_failed_phase()
+                    raise
+                finally:
+                    RECORDER.phase("close_session")
+                    t0 = time.perf_counter()
+                    with span("close_session"):
+                        close_session(ssn)
+                    RECORDER.phase_done(
+                        "close_session", (time.perf_counter() - t0) * 1e3
                     )
-            finally:
-                close_session(ssn)
-        metrics.update_e2e_duration(time.perf_counter() - cycle_start)
+        e2e = time.perf_counter() - cycle_start
+        metrics.update_e2e_duration(e2e)
+        RECORDER.phase("done")
+        RECORDER.end_cycle(e2e_ms=round(e2e * 1e3, 3))

@@ -48,6 +48,10 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--trace", default=None, metavar="PATH",
                         help="record the run's JSONL trace to PATH")
     parser.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="export a Chrome trace-event JSON of the run's spans to "
+             "PATH (open in Perfetto; spans carry virtual timestamps)")
+    parser.add_argument(
         "--replay", default=None, metavar="PATH",
         help="replay a recorded trace instead of generating events; "
              "per-cycle placements are verified against the recording")
@@ -88,6 +92,7 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         backend=ns.backend,
         topk=ns.topk,
         trace_path=ns.trace,
+        trace_out=ns.trace_out,
         replay=replay,
         check_invariants=ns.check,
     )

@@ -31,6 +31,8 @@ from .. import metrics
 from ..api import PodPhase, build_resource_list
 from ..cache import SchedulerCache
 from ..cluster import InProcessCluster
+from ..obs import RECORDER
+from ..obs.tracer import TRACER
 from ..scheduler import Scheduler
 from ..utils.test_utils import build_node, build_pod, build_pod_group, build_queue
 from .clock import VirtualClock
@@ -80,6 +82,9 @@ class SimConfig:
     replay: Optional[TraceReader] = None
     check_invariants: bool = True
     recreate_killed: bool = True    # controller analog for killed pods
+    # Chrome trace-event export of the whole run (--trace-out): spans
+    # carry the virtual clock's timestamp in their args.
+    trace_out: Optional[str] = None
 
 
 @dataclass
@@ -95,6 +100,11 @@ class SimReport:
     jobs_completed: int = 0
     wall_seconds: float = 0.0
     check_seconds: float = 0.0
+    # Flight-recorder dump files written alongside the JSONL trace
+    # (one per invariant-violation/cycle-error event) and the exported
+    # Chrome trace path, when armed.
+    flight_dumps: List[str] = field(default_factory=list)
+    trace_out: Optional[str] = None
 
     @property
     def cycles_per_sec(self) -> float:
@@ -116,6 +126,8 @@ class SimReport:
             "wall_seconds": round(self.wall_seconds, 3),
             "cycles_per_sec": round(self.cycles_per_sec, 1),
             "invariant_check_seconds": round(self.check_seconds, 3),
+            "flight_dumps": list(self.flight_dumps),
+            "trace_out": self.trace_out,
         }
 
 
@@ -193,6 +205,14 @@ class ClusterSimulator:
             raise
 
         self.report = SimReport()
+        # Chrome-trace export of the run: enable the global tracer and
+        # stamp every span with the virtual clock, so the exported
+        # timeline can be correlated with trace-cycle records.
+        self._tracing = cfg.trace_out is not None
+        if self._tracing:
+            TRACER.reset()
+            TRACER.enable()
+            TRACER.annotator = lambda: {"vtime": self.clock.now()}
         # Deterministic bookkeeping.
         self._seq = 0                      # event timestamp tiebreaker
         self._job_specs: Dict[str, dict] = {}
@@ -229,6 +249,15 @@ class ClusterSimulator:
             self.cache.shutdown()
         finally:
             self.writer.close()
+            if self._tracing:
+                try:
+                    self.report.trace_out = TRACER.export(
+                        self.cfg.trace_out
+                    )
+                except OSError:
+                    logger.exception("sim trace export failed")
+                TRACER.annotator = None
+                TRACER.disable()
             self._restore_env()
 
     def run(self) -> SimReport:
@@ -349,6 +378,10 @@ class ClusterSimulator:
                     os.environ["KBT_SOLVER"] = prev_solver
         if not ok:
             self.report.cycle_errors += 1
+            # Forensics alongside the JSONL trace: the flight recorder's
+            # last record carries the failing phase + traceback
+            # (committed by run_once_guarded's error path).
+            self._flight_dump(cycle, "cycle-error")
             # The guarded production loop would back off; virtual time
             # pays the same penalty.
             self.clock.advance(self.scheduler.cycle_error_backoff())
@@ -395,6 +428,8 @@ class ClusterSimulator:
             for v in violations:
                 metrics.register_sim_violation(v["invariant"])
             self.report.violations.extend(violations)
+            if violations:
+                self._flight_dump(cycle, "violation")
         metrics.register_sim_cycle()
         self.report.placements += len(placements)
 
@@ -413,6 +448,20 @@ class ClusterSimulator:
         if self.replaying and rec is not None:
             if placements != rec.get("placements", []):
                 self.report.replay_mismatches.append(cycle)
+
+    def _flight_dump(self, cycle: int, reason: str) -> None:
+        """Write the flight-recorder ring next to the JSONL trace (no-op
+        without a trace path — the ring still holds the records for
+        callers that read the recorder directly)."""
+        base = self.cfg.trace_path
+        if not base:
+            return
+        path = f"{base}.flight-{reason}-c{cycle}.json"
+        try:
+            RECORDER.dump_to(path, reason=f"sim-{reason}")
+            self.report.flight_dumps.append(path)
+        except OSError:
+            logger.exception("sim flight dump failed")
 
     # -- settling ------------------------------------------------------------
 

@@ -715,7 +715,12 @@ def tensorize(
     node_max_tasks = node_maxt.copy()
 
     # --- predicates → factorized mask (tier-gated like predicate_fn) ------
-    mask_parts = [fn(tasks, nodes) for name, fn in ssn.batch_predicates()]
+    from ..obs import span as _span
+
+    with _span("predicate_mask"):
+        mask_parts = [
+            fn(tasks, nodes) for name, fn in ssn.batch_predicates()
+        ]
     # Scalar-only predicate plugins (no batched form) fall back to the
     # per-pair path so correctness never depends on a plugin being ported.
     scalar_only = ssn.scalar_only_predicates()
@@ -757,12 +762,13 @@ def tensorize(
     cand_sel = None
     sparse_reason = tk.reason
     if tk.enabled:
-        cand_sel = select_candidates(
-            mask, score_rows_map, task_req, task_fit,
-            node_idle, node_cap, node_releasing,
-            node_task_count, node_max_tasks,
-            layout.eps(), lr_w, br_w, tk.k,
-        )
+        with _span("topk_select", k=tk.k):
+            cand_sel = select_candidates(
+                mask, score_rows_map, task_req, task_fit,
+                node_idle, node_cap, node_releasing,
+                node_task_count, node_max_tasks,
+                layout.eps(), lr_w, br_w, tk.k,
+            )
         if cand_sel is None:
             sparse_reason = "class-budget"
     sparse_stats = {

@@ -1,0 +1,74 @@
+"""Simulator observability: flight-recorder dumps alongside the JSONL
+trace on induced cycle errors, and --trace-out Chrome trace export with
+virtual-time-stamped spans.
+"""
+
+import json
+import os
+
+from kube_batch_tpu.sim import SimConfig, WorkloadSpec
+from kube_batch_tpu.sim.harness import run_sim
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        cycles=12,
+        seed=1,
+        workload=WorkloadSpec(nodes=12),
+        backend="auto",
+    )
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def test_cycle_error_writes_flight_dump_with_failing_phase(tmp_path):
+    trace = str(tmp_path / "run.jsonl")
+    report, _records = run_sim(_cfg(
+        tmp_path, faults="crash:0.4", trace_path=trace,
+    ))
+    assert report.cycle_errors > 0
+    assert report.flight_dumps, "no flight dump recorded"
+    path = report.flight_dumps[0]
+    assert os.path.exists(path)
+    assert path.startswith(trace)  # alongside the JSONL trace
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "sim-cycle-error"
+    last = dump["records"][-1]
+    assert last["ok"] is False
+    # The failing phase is the injected crash action, and the record
+    # carries the traceback of the absorbed exception.
+    assert last["phase"] == "action:sim-crash"
+    assert "injected scheduler-cycle crash" in last["error"]
+    assert any(
+        "SimBindFailure" in line for line in last["traceback"]
+    )
+
+
+def test_clean_run_writes_no_flight_dump(tmp_path):
+    trace = str(tmp_path / "clean.jsonl")
+    report, _records = run_sim(_cfg(tmp_path, trace_path=trace))
+    assert report.cycle_errors == 0
+    assert not report.violations
+    assert report.flight_dumps == []
+
+
+def test_trace_out_exports_virtual_time_spans(tmp_path):
+    out = str(tmp_path / "sim.trace.json")
+    report, _records = run_sim(_cfg(tmp_path, trace_out=out))
+    assert report.trace_out == out
+    with open(out) as f:
+        doc = json.load(f)
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    # The full cycle taxonomy shows up...
+    assert {"cycle", "open_session", "close_session"} <= names
+    assert "action:allocate_tpu" in names
+    # ...and every span is stamped with the virtual clock.
+    assert all("vtime" in e["args"] for e in spans)
+    cycles = {e["args"]["cycle"] for e in spans if e["name"] == "cycle"}
+    assert len(cycles) == 12
+    # Tracer is disarmed after the run (no leak into later tests).
+    from kube_batch_tpu.obs.tracer import TRACER
+
+    assert not TRACER.enabled

@@ -1,0 +1,317 @@
+"""Explainability tests: per-cycle verdicts from the real allocate_tpu
+action (predicate-blocked and gang-minMember-break gangs), the deep
+per-predicate diagnosis, the explain CLI, and the /debug/jobs surface.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api import PodPhase, build_resource_list
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.framework import close_session, get_action, open_session
+from kube_batch_tpu.obs import explain
+from kube_batch_tpu.utils.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+from tests.actions.test_actions import make_tiers
+
+TIERS_ARGS = (
+    ["priority", "gang", "conformance"],
+    ["drf", "predicates", "proportion", "nodeorder"],
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    explain.clear()
+    yield
+    explain.clear()
+
+
+def _cache():
+    return SchedulerCache(
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+
+
+def _run_allocate_tpu(cache):
+    ssn = open_session(cache, make_tiers(*TIERS_ARGS))
+    action, _ = get_action("allocate_tpu")
+    action.execute(ssn)
+    return ssn
+
+
+def _blocked_gang_cache():
+    """A 3-member gang whose nodeSelector matches no node."""
+    cache = _cache()
+    cache.add_queue(build_queue("default", weight=1))
+    for name in ("n1", "n2"):
+        cache.add_node(build_node(
+            name,
+            build_resource_list(cpu="8", memory="16Gi", pods=110),
+            labels={"zone": "a"},
+        ))
+    cache.add_pod_group(build_pod_group(
+        "blocked", namespace="t", min_member=3, queue="default"
+    ))
+    for i in range(3):
+        cache.add_pod(build_pod(
+            "t", f"b{i}", "", PodPhase.PENDING,
+            build_resource_list(cpu="1000m", memory="1Gi"),
+            group_name="blocked",
+            selector={"zone": "nowhere"},
+        ))
+    return cache
+
+
+def _minmember_gang_cache():
+    """A 3-member gang where only 2 members can ever fit (6 CPU tasks
+    on two 8-CPU nodes): feasible nodes exist, but minMember breaks."""
+    cache = _cache()
+    cache.add_queue(build_queue("default", weight=1))
+    for name in ("n1", "n2"):
+        cache.add_node(build_node(
+            name, build_resource_list(cpu="8", memory="16Gi", pods=110)
+        ))
+    cache.add_pod_group(build_pod_group(
+        "biggang", namespace="t", min_member=3, queue="default"
+    ))
+    for i in range(3):
+        cache.add_pod(build_pod(
+            "t", f"g{i}", "", PodPhase.PENDING,
+            build_resource_list(cpu="6000m", memory="1Gi"),
+            group_name="biggang",
+        ))
+    return cache
+
+
+def test_predicate_blocked_gang_verdict():
+    cache = _blocked_gang_cache()
+    ssn = _run_allocate_tpu(cache)
+    try:
+        verdict = explain.get_verdict("t/blocked")
+        assert verdict is not None
+        assert verdict.reason == explain.REASON_PREDICATE
+        assert verdict.unassigned == 3
+        assert verdict.detail["feasible_nodes"] == 0
+        assert verdict.detail["min_available"] == 3
+        # Stamped on the session JobInfo too.
+        assert ssn.jobs["t/blocked"].last_unschedulable is verdict
+        # Reason-labeled metric carries the task count.
+        assert metrics.unschedulable_tasks.get(
+            (explain.REASON_PREDICATE,)
+        ) == 3.0
+    finally:
+        close_session(ssn)
+        cache.shutdown()
+
+
+def test_minmember_break_gang_verdict():
+    cache = _minmember_gang_cache()
+    ssn = _run_allocate_tpu(cache)
+    try:
+        verdict = explain.get_verdict("t/biggang")
+        assert verdict is not None
+        assert verdict.reason == explain.REASON_GANG
+        # Two members allocate (held at the session's gang gate, never
+        # dispatched — the job is not Ready); the third cannot fit.
+        assert verdict.unassigned == 1
+        assert verdict.detail["ready_tasks"] == 2
+        assert verdict.detail["min_available"] == 3
+        assert "gang needs 3, has 2 ready" in verdict.message
+        assert metrics.unschedulable_tasks.get(
+            (explain.REASON_GANG,)
+        ) == 1.0
+    finally:
+        close_session(ssn)
+        cache.shutdown()
+
+
+def test_verdict_cleared_when_job_schedulable():
+    """A gang that fits leaves no verdict (and a stale one from an
+    earlier cycle is dropped)."""
+    cache = _cache()
+    cache.add_queue(build_queue("default", weight=1))
+    cache.add_node(build_node(
+        "n1", build_resource_list(cpu="8", memory="16Gi", pods=110)
+    ))
+    cache.add_pod_group(build_pod_group(
+        "ok", namespace="t", min_member=2, queue="default"
+    ))
+    for i in range(2):
+        cache.add_pod(build_pod(
+            "t", f"p{i}", "", PodPhase.PENDING,
+            build_resource_list(cpu="1000m", memory="1Gi"),
+            group_name="ok",
+        ))
+    ssn = _run_allocate_tpu(cache)
+    try:
+        assert explain.get_verdict("t/ok") is None
+    finally:
+        close_session(ssn)
+        cache.shutdown()
+
+
+def test_idle_cycle_clears_stale_verdicts_and_gauge():
+    """A job deleted after a verdict was recorded must drop from the
+    registry and zero its gauge bucket on the next (idle) cycle, even
+    though tensorize has nothing to solve."""
+    cache = _blocked_gang_cache()
+    ssn = _run_allocate_tpu(cache)
+    assert explain.get_verdict("t/blocked") is not None
+    assert metrics.unschedulable_tasks.get(
+        (explain.REASON_PREDICATE,)
+    ) == 3.0
+    close_session(ssn)
+    # The gang leaves the cluster entirely.
+    for i in range(3):
+        cache.delete_pod(cache.jobs["t/blocked"].tasks[f"t-b{i}"].pod)
+    ssn = _run_allocate_tpu(cache)  # idle: tensorize returns nothing
+    try:
+        assert explain.get_verdict("t/blocked") is None
+        assert metrics.unschedulable_tasks.get(
+            (explain.REASON_PREDICATE,)
+        ) == 0.0
+    finally:
+        close_session(ssn)
+        cache.shutdown()
+
+
+def test_diagnose_names_the_blocking_predicate():
+    cache = _blocked_gang_cache()
+    ssn = _run_allocate_tpu(cache)
+    try:
+        diag = explain.diagnose_job(ssn, ssn.jobs["t/blocked"])
+        rep = diag["representative"]
+        assert rep["feasible_nodes"] == 0
+        assert rep["blocked_by"] == {"MatchNodeSelector": 2}
+        text = explain.format_diagnosis(diag)
+        assert "gang needs 3" in text
+        assert "0/2 node(s) feasible" in text
+        assert "MatchNodeSelector(2)" in text
+        assert "predicate-blocked" in text  # the last-cycle verdict
+    finally:
+        close_session(ssn)
+        cache.shutdown()
+
+
+def test_diagnose_minmember_shortfall():
+    cache = _minmember_gang_cache()
+    ssn = _run_allocate_tpu(cache)
+    try:
+        diag = explain.diagnose_job(ssn, ssn.jobs["t/biggang"])
+        # Post-apply state: the two allocated members consumed the
+        # idle capacity, so the remaining pending member fits nowhere.
+        assert diag["representative"]["feasible_nodes"] == 0
+        assert diag["representative"]["no_fit_nodes"] == 2
+        assert diag["min_available"] == 3
+        assert diag["ready_tasks"] == 2
+        assert diag["pending_tasks"] == 1
+        text = explain.format_diagnosis(diag)
+        assert "gang needs 3, has 2 ready" in text
+        assert "0/2 node(s) feasible" in text
+        assert "2 node(s) pass predicates but lack capacity" in text
+        assert "gang-minmember" in text
+    finally:
+        close_session(ssn)
+        cache.shutdown()
+
+
+def test_explain_cli_offline(tmp_path, capsys):
+    state = {
+        "queues": [{"name": "default", "weight": 1}],
+        "nodes": [
+            {"name": "n1",
+             "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+             "labels": {"zone": "a"}},
+        ],
+        "podGroups": [
+            {"name": "stuck", "namespace": "default", "minMember": 2,
+             "queue": "default"},
+        ],
+        "pods": [
+            {"name": f"s{i}", "namespace": "default", "group": "stuck",
+             "requests": {"cpu": "1000m", "memory": "1Gi"},
+             "nodeSelector": {"zone": "nowhere"}}
+            for i in range(2)
+        ],
+    }
+    import yaml
+
+    path = tmp_path / "state.yaml"
+    path.write_text(yaml.safe_dump(state))
+    rc = explain.cli_main(["default/stuck", "--cluster-state", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "gang needs 2" in out
+    assert "MatchNodeSelector(1)" in out
+
+
+def test_explain_cli_unknown_job(tmp_path, capsys):
+    import yaml
+
+    path = tmp_path / "state.yaml"
+    path.write_text(yaml.safe_dump({
+        "queues": [{"name": "default", "weight": 1}],
+        "nodes": [{"name": "n1",
+                   "allocatable": {"cpu": "8", "memory": "16Gi",
+                                   "pods": "110"}}],
+    }))
+    rc = explain.cli_main(["default/ghost", "--cluster-state", str(path)])
+    assert rc == 3
+    assert "not found" in capsys.readouterr().out
+
+
+def test_debug_jobs_endpoint_serves_verdict():
+    from kube_batch_tpu.cli import start_metrics_server
+
+    cache = _blocked_gang_cache()
+    ssn = _run_allocate_tpu(cache)
+    server, _thread = start_metrics_server("127.0.0.1:0")
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/jobs/t/blocked", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["verdict"]["reason"] == explain.REASON_PREDICATE
+        assert doc["verdict"]["unassigned"] == 3
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/jobs", timeout=5
+        ) as resp:
+            listing = json.loads(resp.read().decode())
+        assert any(
+            v["uid"] == "t/blocked" for v in listing["jobs"]
+        )
+    finally:
+        server.shutdown()
+        close_session(ssn)
+        cache.shutdown()
+
+
+def test_victim_note_folds_into_verdict():
+    cache = _blocked_gang_cache()
+    explain.note_victim_outcome("t/blocked", "preempt", 2, False)
+    ssn = _run_allocate_tpu(cache)
+    try:
+        verdict = explain.get_verdict("t/blocked")
+        vs = verdict.detail["victim_selection"]
+        assert vs["action"] == "preempt"
+        assert vs["victims"] == 2
+        assert vs["placed"] is False
+    finally:
+        close_session(ssn)
+        cache.shutdown()

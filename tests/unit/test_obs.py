@@ -1,0 +1,344 @@
+"""Observability layer tests: span tracer (thread safety, cross-thread
+nesting, export), flight-recorder ring (wraparound, error capture,
+SIGUSR1 dump roundtrip), and the /debug HTTP surface.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+from urllib.error import HTTPError
+
+import pytest
+
+from kube_batch_tpu.obs.flightrecorder import FlightRecorder, install_sigusr1
+from kube_batch_tpu.obs.tracer import Tracer
+
+
+# ------------------------------------------------------------------ tracer
+
+
+def test_disabled_span_records_nothing():
+    t = Tracer()
+    with t.span("a"):
+        with t.span("b"):
+            pass
+    assert t.events() == []
+    assert t.spans_recorded == 0
+
+
+def test_span_nesting_and_args():
+    t = Tracer()
+    t.enable()
+    t.begin_cycle(7)
+    with t.span("outer"):
+        with t.span("inner", k=64):
+            pass
+    events = {e["name"]: e for e in t.events()}
+    assert set(events) == {"outer", "inner"}
+    outer, inner = events["outer"], events["inner"]
+    assert inner["args"]["parent"] == outer["args"]["sid"]
+    assert outer["args"]["parent"] == 0
+    assert inner["args"]["cycle"] == 7
+    assert inner["args"]["k"] == 64
+    assert inner["ph"] == "X"
+    assert inner["dur"] >= 0
+
+
+def test_complete_records_retroactive_span():
+    t = Tracer()
+    t.enable()
+    t0 = time.perf_counter()
+    time.sleep(0.001)
+    with t.span("parent"):
+        t.complete("apply", t0)
+    by_name = {e["name"]: e for e in t.events()}
+    assert by_name["apply"]["args"]["parent"] == (
+        by_name["parent"]["args"]["sid"]
+    )
+    assert by_name["apply"]["dur"] >= 1000  # >= 1ms in us
+
+
+def test_worker_spans_nest_under_the_right_cycle():
+    """Spans opened on worker threads (the overlapped solve/apply
+    pattern) adopt the submitting span's id and the cycle stamp."""
+    t = Tracer()
+    t.enable()
+    t.begin_cycle(3)
+    results = []
+
+    barrier = threading.Barrier(4)
+
+    with t.span("cycle_span"):
+        token = t.capture()
+
+        def worker(i):
+            # Barrier: all four workers are alive at once, so their
+            # thread idents are guaranteed distinct (idents can be
+            # reused once a thread exits).
+            barrier.wait(timeout=10)
+            with t.adopt(token), t.span(f"worker-{i}"):
+                time.sleep(0.002)
+            results.append(i)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+
+    events = {e["name"]: e for e in t.events()}
+    cycle_sid = events["cycle_span"]["args"]["sid"]
+    tids = set()
+    for i in range(4):
+        ev = events[f"worker-{i}"]
+        assert ev["args"]["parent"] == cycle_sid
+        assert ev["args"]["cycle"] == 3
+        tids.add(ev["tid"])
+    assert len(tids) == 4  # genuinely distinct tracks
+    assert sorted(results) == [0, 1, 2, 3]
+
+
+def test_adopted_spans_keep_the_capturing_cycle():
+    """Async side effects drain in the NEXT cycle's overlap window by
+    design — their spans must still stamp the cycle that queued them,
+    not whatever the scheduler thread advanced the counter to."""
+    t = Tracer()
+    t.enable()
+    t.begin_cycle(5)
+    with t.span("submitter"):
+        token = t.capture()
+    t.begin_cycle(6)  # scheduler moved on before the worker drained
+
+    def worker():
+        with t.adopt(token), t.span("late-side-effect"):
+            with t.span("nested"):
+                pass
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    events = {e["name"]: e for e in t.events()}
+    assert events["submitter"]["args"]["cycle"] == 5
+    assert events["late-side-effect"]["args"]["cycle"] == 5
+    assert events["nested"]["args"]["cycle"] == 5
+    # A fresh span on the main thread sees the advanced cycle.
+    with t.span("current"):
+        pass
+    assert {e["name"]: e for e in t.events()}["current"]["args"][
+        "cycle"
+    ] == 6
+
+
+def test_tracer_thread_safety_under_contention():
+    """Many threads spanning concurrently: every span is recorded, no
+    event is torn/corrupt."""
+    t = Tracer(capacity=100_000)
+    t.enable()
+    n_threads, per_thread = 8, 200
+
+    def hammer(k):
+        for i in range(per_thread):
+            with t.span("s", thread=k, i=i):
+                pass
+
+    threads = [
+        threading.Thread(target=hammer, args=(k,))
+        for k in range(n_threads)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    events = t.events()
+    assert len(events) == n_threads * per_thread
+    assert t.spans_recorded == n_threads * per_thread
+    sids = [e["args"]["sid"] for e in events]
+    assert len(set(sids)) == len(sids)  # unique span ids
+
+
+def test_event_ring_caps_memory():
+    t = Tracer(capacity=10)
+    t.enable()
+    for i in range(25):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t.events()) == 10
+    assert t.spans_recorded == 25
+    assert t.dropped == 15
+    # The ring keeps the NEWEST spans.
+    assert t.events()[-1]["name"] == "s24"
+
+
+def test_export_chrome_trace(tmp_path):
+    t = Tracer()
+    t.enable()
+    with t.span("a"):
+        with t.span("b"):
+            pass
+    path = t.export(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    metas = [e for e in events if e.get("ph") == "M"]
+    assert {e["name"] for e in xs} == {"a", "b"}
+    assert metas and metas[0]["name"] == "thread_name"
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_ring_buffer_wraparound():
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.begin_cycle(i)
+        fr.phase("open_session")
+        fr.phase_done("open_session", 1.0)
+        fr.end_cycle(e2e_ms=float(i))
+    records = fr.snapshot()
+    assert len(records) == 4
+    assert [r["cycle"] for r in records] == [6, 7, 8, 9]
+    assert all(r["ok"] for r in records)
+    # seq keeps counting monotonically across wraps.
+    assert [r["seq"] for r in records] == [7, 8, 9, 10]
+
+
+def test_error_capture_pins_failing_phase():
+    fr = FlightRecorder(capacity=8)
+    fr.begin_cycle(0)
+    fr.phase("action:allocate_tpu")
+    try:
+        raise RuntimeError("kaboom")
+    except RuntimeError as exc:
+        # Scheduler's finally moves the phase on; the pinned
+        # failed phase must win in the committed record.
+        fr.mark_failed_phase()
+        fr.phase("close_session")
+        fr.record_error(exc)
+    last = fr.snapshot()[-1]
+    assert last["ok"] is False
+    assert last["phase"] == "action:allocate_tpu"
+    assert "RuntimeError: kaboom" in last["error"]
+    assert any("kaboom" in line for line in last["traceback"])
+    assert fr.error_count == 1
+
+
+def test_annotate_and_open_record_in_dump():
+    fr = FlightRecorder(capacity=4)
+    fr.begin_cycle(0)
+    fr.annotate("solver", {"backend": "native", "placed": 10})
+    dump = json.loads(fr.dump_json("test"))
+    assert dump["type"] == "flightrecorder"
+    assert dump["records"][-1]["in_flight"] is True
+    assert dump["records"][-1]["solver"]["backend"] == "native"
+    # Canonical: dumps twice byte-identically (modulo dumped_at).
+    fr.end_cycle()
+
+
+def test_annotate_coerces_unserializable_values():
+    import numpy as np
+
+    fr = FlightRecorder(capacity=2)
+    fr.begin_cycle(0)
+    fr.annotate("solver", {
+        "placed": np.int64(5), "frac": np.float32(0.5),
+        "obj": object(),
+    })
+    fr.end_cycle()
+    dump = json.loads(fr.dump_json("test"))
+    solver = dump["records"][-1]["solver"]
+    assert solver["placed"] == 5
+    assert isinstance(solver["obj"], str)
+
+
+def test_sigusr1_dump_roundtrip(tmp_path):
+    fr_dir = str(tmp_path)
+    from kube_batch_tpu.obs.flightrecorder import RECORDER
+
+    RECORDER.begin_cycle(0)
+    RECORDER.phase("action:allocate_tpu")
+    RECORDER.end_cycle(e2e_ms=1.0)
+    installed = install_sigusr1(fr_dir)
+    if not installed:
+        pytest.skip("cannot install SIGUSR1 handler on this platform")
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.time() + 5.0
+        dumps = []
+        while time.time() < deadline:
+            dumps = [
+                f for f in os.listdir(fr_dir) if "sigusr1" in f
+            ]
+            if dumps:
+                break
+            time.sleep(0.02)
+        assert dumps, "SIGUSR1 produced no dump file"
+        with open(os.path.join(fr_dir, dumps[0])) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "sigusr1"
+        assert doc["records"], "dump carries no records"
+        assert doc["records"][-1]["phases_ms"] is not None
+    finally:
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+
+# ------------------------------------------------------------ HTTP surface
+
+
+@pytest.fixture
+def debug_server():
+    from kube_batch_tpu.cli import start_metrics_server
+
+    server, _thread = start_metrics_server("127.0.0.1:0")
+    port = server.server_address[1]
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_healthz_and_debug_vars(debug_server):
+    status, body = _get(f"{debug_server}/healthz")
+    assert status == 200 and body == "ok\n"
+    status, body = _get(f"{debug_server}/debug/vars")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["version"]
+    assert doc["uptime_seconds"] >= 0
+    assert "cycle_errors" in doc
+    assert "last_cycle_age_seconds" in doc
+
+
+def test_debug_flightrecorder_endpoint(debug_server):
+    from kube_batch_tpu.obs.flightrecorder import RECORDER
+
+    RECORDER.begin_cycle(0)
+    RECORDER.end_cycle()
+    status, body = _get(f"{debug_server}/debug/flightrecorder")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["type"] == "flightrecorder"
+    assert doc["records"]
+
+
+def test_unknown_path_gets_404_with_body(debug_server):
+    with pytest.raises(HTTPError) as err:
+        _get(f"{debug_server}/nope/nothing")
+    assert err.value.code == 404
+    body = err.value.read().decode()
+    assert "/nope/nothing" in body  # NOT a silent empty 404
+
+
+def test_debug_jobs_unknown_job_404(debug_server):
+    with pytest.raises(HTTPError) as err:
+        _get(f"{debug_server}/debug/jobs/ns/ghost")
+    assert err.value.code == 404
+    assert "ns/ghost" in err.value.read().decode()
