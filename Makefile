@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke verify ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke bench-compare verify ci image clean
 
 all: native
 
@@ -59,6 +59,31 @@ sim-smoke:
 		--faults "bind:0.05,node-flap:0.02,crash:0.02" \
 		--node-churn 0.03 --quiet
 
+# Scaled-down soak (the 100k-cycle reference run's CI tier): 2k virtual
+# cycles with per-cycle telemetry, then the leak/drift detectors fit
+# every watermark series (RSS, alloc blocks, jit cache, metrics label
+# cardinality, fairness drift) — exit 4 on ANY detector trip. Uses the
+# native backend (built by `make native`, ordered before this in ci)
+# so 2k cycles stay ~30 s. doc/design/observability.md.
+soak-smoke:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim --cycles 2000 --seed 3 \
+		--backend native --soak --quiet
+
+# Bench regression sentinel across the two newest committed bench
+# rounds (noise-aware: canary-normalized thresholds + the explicit
+# allowlist), THEN its own self-test: an injected 20% cycle_ms
+# regression must flip the exit code — a sentinel that cannot see a
+# regression is decoration.
+bench-compare:
+	$(PY) tools/bench_compare.py \
+		$$(ls BENCH_r*.json | sort | tail -2 | head -1) \
+		$$(ls BENCH_r*.json | sort | tail -1) \
+		--allow-file tools/bench_allowlist.json
+	$(PY) tools/bench_compare.py \
+		$$(ls BENCH_r*.json | sort | tail -2 | head -1) \
+		$$(ls BENCH_r*.json | sort | tail -1) \
+		--self-test --allow-file tools/bench_allowlist.json
+
 # Static checks (reference verify: gofmt/goimports/golint,
 # Makefile:13-17): byte-compile + the AST lint (unused/duplicate
 # imports, star imports, syntax) + the metrics census drift guard
@@ -77,7 +102,7 @@ verify:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify native test bench-smoke sim-smoke
+ci: verify native test bench-smoke sim-smoke soak-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

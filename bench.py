@@ -490,17 +490,17 @@ def bench_cycle(cfg, seed=0, cache=None, trace_path=None,
         out["trace_spans_dropped"] = TRACER.dropped
         TRACER.disable()
     if measure_obs:
-        out["obs"] = bench_obs(one_cycle)
+        out["obs"] = bench_obs(one_cycle, cache=cache)
     cache.shutdown()
     return out
 
 
-def bench_obs(one_cycle, runs=7):
-    """Tracer overhead at the benched shape.
+def bench_obs(one_cycle, runs=7, cache=None):
+    """Tracer + telemetry overhead at the benched shape.
 
-    Two measurements, because cycle-to-cycle wall-time variance at 50k
-    scale (GC, allocator state) is orders of magnitude larger than the
-    microseconds a handful of spans cost:
+    Two tracer measurements, because cycle-to-cycle wall-time variance
+    at 50k scale (GC, allocator state) is orders of magnitude larger
+    than the microseconds a handful of spans cost:
 
     - **pinned overhead** = measured per-span cost (tight microbench of
       the enabled span path) x spans recorded per cycle, as a fraction
@@ -508,6 +508,12 @@ def bench_obs(one_cycle, runs=7):
       number the <1%-of-an-idle-cycle budget is checked against;
     - **a/b delta** = interleaved off/on cycle medians, reported as
       corroborating evidence (expected to sit inside run noise).
+
+    Plus the telemetry enabled-path cost: the full per-cycle
+    ``observe_scheduler_cycle`` (flight-record extraction, watermark
+    probes, the amortized fairness probe against the REAL benched
+    cache) timed over enough cycles to include window rolls and
+    fairness refreshes — pinned against the same <1% budget.
     """
     from kube_batch_tpu.obs.tracer import TRACER
 
@@ -543,9 +549,39 @@ def bench_obs(one_cycle, runs=7):
     TRACER.reset()
     TRACER.enabled = was_enabled
 
+    # Telemetry enabled-path cost: a scratch Telemetry instance (the
+    # global one must not absorb bench samples) fed a representative
+    # flight record + the real cache, 1024 cycles — covering 16 window
+    # rolls, 16 expensive-probe/fairness samples (both on the 64-cycle
+    # tier), and a node-total refresh, so the amortized probes are
+    # priced in, not dodged.
+    from kube_batch_tpu.obs.telemetry import Telemetry
+
+    scratch = Telemetry(window_cycles=64, max_windows=64,
+                        raw_capacity=128)
+    fake_rec = {
+        "e2e_ms": off_ms,
+        "phases_ms": {
+            "open_session": 2.0,
+            "action:allocate_tpu": off_ms * 0.8,
+            "close_session": 2.0,
+        },
+        "solver": {"placed": 0, "tasks": 0, "rounds": 1},
+    }
+    telem_n = 1024
+    t0 = time.perf_counter()
+    for _ in range(telem_n):
+        scratch.observe_scheduler_cycle(fake_rec, cache=cache)
+    telemetry_cost_us = (time.perf_counter() - t0) / telem_n * 1e6
+
     overhead_ms = spans_per_cycle * span_cost_us / 1e3
     delta_ms = max(0.0, on_ms - off_ms)
     return {
+        "telemetry_cost_us": round(telemetry_cost_us, 2),
+        "telemetry_overhead_pct": (
+            round(telemetry_cost_us / 1e3 / off_ms * 100.0, 3)
+            if off_ms else 0.0
+        ),
         "idle_cycle_off_ms": round(off_ms, 3),
         "idle_cycle_on_ms": round(on_ms, 3),
         "spans_per_cycle": round(spans_per_cycle, 1),

@@ -431,7 +431,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 if terminated:
                     self.jobs.pop(job.uid, None)
                     removed += 1
-            if not terminated:
+            if terminated:
+                self._forget_job_metrics(job)
+            else:
                 self._queue_job_cleanup(job, attempt + 1)
         return removed
 
@@ -446,9 +448,24 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 terminated = job_terminated(job)
                 if terminated:
                     self.jobs.pop(job.uid, None)
-            if not terminated:
+            if terminated:
+                self._forget_job_metrics(job)
+            else:
                 self._stop.wait(self._retry_delay(attempt))
                 self._queue_job_cleanup(job, attempt + 1)
+
+    @staticmethod
+    def _forget_job_metrics(job: JobInfo) -> None:
+        """Label-set GC: a removed job's per-job metric series
+        (``unschedule_task_count`` / ``job_retry_counts``, keyed on the
+        pod-group name the gang plugin labels with) must leave the
+        registry with it — an unbounded-cardinality leak otherwise."""
+        try:
+            from .. import metrics
+
+            metrics.forget_job(job.name)
+        except Exception:  # pragma: no cover - metrics must never kill
+            logger.exception("job metric label GC failed")
 
     # -- snapshot (reference cache.go:612-659) --------------------------------
 

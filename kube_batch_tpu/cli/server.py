@@ -21,8 +21,9 @@ from typing import Optional, Tuple
 from .. import metrics
 from ..cache import new_scheduler_cache
 from ..cluster import ClusterAPI, InProcessCluster
-from ..obs import RECORDER
+from ..obs import RECORDER, TELEMETRY
 from ..obs import explain as obs_explain
+from ..obs import telemetry as obs_telemetry
 from ..scheduler import Scheduler
 from ..version import RELEASE_VERSION
 from .options import (
@@ -44,7 +45,12 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     - ``/healthz``: cheap liveness ("ok") — probes must not scrape the
       full exposition;
     - ``/debug/vars``: uptime, version, last-cycle age, cycle error
-      count as one small JSON object;
+      count, plus a resource-health snapshot (process RSS, allocator
+      blocks, JAX device memory and live buffers, jit cache sizes,
+      telemetry ring occupancy) as one small JSON object — one curl
+      answers "is this process healthy";
+    - ``/debug/timeseries``: the long-horizon telemetry windows + the
+      newest raw per-cycle samples (obs/telemetry.py);
     - ``/debug/flightrecorder``: the flight recorder's ring as
       canonical JSON (obs/flightrecorder.py);
     - ``/debug/jobs`` and ``/debug/jobs/<ns>/<name>``: per-job last
@@ -65,7 +71,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     def _debug_vars(self) -> dict:
         now = time.time()
         last = RECORDER.last_cycle_ts
-        return {
+        out = {
             "version": RELEASE_VERSION,
             "pid": os.getpid(),
             "uptime_seconds": round(now - _SERVER_STARTED[0], 3),
@@ -75,7 +81,24 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             "cycles_recorded": RECORDER._seq,
             "cycle_errors": metrics.scheduler_cycle_errors.get(),
             "unschedulable_jobs": len(obs_explain.all_verdicts()),
+            "telemetry": {
+                "cycles_observed": TELEMETRY.cycles_observed,
+                "windows_rolled": TELEMETRY.windows_rolled,
+                "window_cycles": TELEMETRY.window_cycles,
+                "ring_occupancy": len(TELEMETRY._raw),
+            },
         }
+        # Resource-watermark snapshot: same probes the telemetry series
+        # record (RSS, allocator blocks, jax device memory / live
+        # buffers, jit cache sizes, ring occupancies, label-series
+        # cardinality) — a single curl gives a health picture.
+        try:
+            out["watermarks"] = obs_telemetry.collect_watermarks(
+                cache=TELEMETRY.attached_cache()
+            )
+        except Exception:  # pragma: no cover - probes must not 500
+            logger.exception("/debug/vars watermark probe failed")
+        return out
 
     def do_GET(self):  # noqa: N802 (http.server API)
         path = self.path.split("?", 1)[0].rstrip("/")
@@ -89,6 +112,13 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         elif path == "/debug/vars":
             self._reply(
                 json.dumps(self._debug_vars(), sort_keys=True) + "\n",
+                ctype="application/json",
+            )
+        elif path == "/debug/timeseries":
+            self._reply(
+                json.dumps(
+                    TELEMETRY.snapshot(), sort_keys=True, default=repr
+                ) + "\n",
                 ctype="application/json",
             )
         elif path == "/debug/flightrecorder":

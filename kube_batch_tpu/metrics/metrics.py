@@ -22,6 +22,18 @@ _DEF_BUCKETS = [
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 ]
 
+# Millisecond-scale buckets (seconds) for the cycle-shaped histograms
+# (e2e / per-action / solver-phase). A steady production cycle runs
+# ~10-300 ms; prometheus.DefBuckets puts exactly FOUR boundaries in
+# that range (25/50/100/250 ms), so every cycle-latency quantile
+# collapsed into the same handful of buckets. These give ~15%
+# resolution across 1 ms - 1 s and keep a coarse multi-second tail for
+# cold/degraded cycles. Bucket policy: doc/design/metrics.md.
+MS_BUCKETS = [
+    0.001, 0.0025, 0.005, 0.0075, 0.01, 0.015, 0.02, 0.03, 0.045,
+    0.065, 0.09, 0.125, 0.175, 0.25, 0.35, 0.5, 0.75, 1.0, 2.5, 10.0,
+]
+
 
 class _Metric:
     def __init__(self, name: str, help_text: str):
@@ -42,6 +54,16 @@ class Counter(_Metric):
     def get(self, labels: Tuple = ()) -> float:
         return self._values.get(labels, 0.0)
 
+    def remove(self, labels: Tuple) -> bool:
+        """Drop one label set (label GC for deleted subjects — without
+        this, per-job series accumulate forever; Prometheus clients
+        call this deleteLabelValues). Returns True if it existed."""
+        with self._lock:
+            return self._values.pop(labels, None) is not None
+
+    def series_count(self) -> int:
+        return len(self._values)
+
     def expose(self, label_names: Tuple = ()) -> List[str]:
         lines = [f"# TYPE {self.name} counter"]
         for labels, v in sorted(self._values.items()):
@@ -61,6 +83,20 @@ class Gauge(_Metric):
 
     def get(self, labels: Tuple = ()) -> float:
         return self._values.get(labels, 0.0)
+
+    def remove(self, labels: Tuple) -> bool:
+        """Drop one label set (see Counter.remove)."""
+        with self._lock:
+            return self._values.pop(labels, None) is not None
+
+    def series_count(self) -> int:
+        return len(self._values)
+
+    def label_sets(self) -> List[Tuple]:
+        """Snapshot of live label sets (label GC sweeps diff against
+        this)."""
+        with self._lock:
+            return list(self._values)
 
     def expose(self, label_names: Tuple = ()) -> List[str]:
         lines = [f"# TYPE {self.name} gauge"]
@@ -120,6 +156,17 @@ class Histogram(_Metric):
     def sum(self, labels: Tuple = ()) -> float:
         return self._sums.get(labels, 0.0)
 
+    def remove(self, labels: Tuple) -> bool:
+        """Drop one label set (see Counter.remove)."""
+        with self._lock:
+            existed = self._totals.pop(labels, None) is not None
+            self._counts.pop(labels, None)
+            self._sums.pop(labels, None)
+            return existed
+
+    def series_count(self) -> int:
+        return len(self._totals)
+
     def expose(self, label_names: Tuple = ()) -> List[str]:
         lines = [f"# TYPE {self.name} histogram"]
         for labels in sorted(self._totals):
@@ -158,6 +205,14 @@ class Registry:
             out.append(name)
         return out
 
+    def series_count(self) -> int:
+        """Total label sets held across every registered metric — the
+        cardinality watermark the soak-mode leak detector fits growth
+        on (a per-job label leak shows here as a line going up)."""
+        return sum(
+            metric.series_count() for metric, _labels in self._metrics
+        )
+
     def expose_text(self) -> str:
         lines: List[str] = []
         for metric, label_names in self._metrics:
@@ -167,16 +222,22 @@ class Registry:
 
 REGISTRY = Registry()
 
-# Metric set mirrors reference metrics.go:37-120.
+# Metric set mirrors reference metrics.go:37-120. The cycle-shaped
+# histograms (e2e / action / solver-phase) get ms-scale buckets: a
+# steady cycle is ~10-300 ms and the default log-spaced set has almost
+# no resolution there (doc/design/metrics.md, bucket policy).
 e2e_scheduling_latency = REGISTRY.register(
-    Histogram("e2e_scheduling_latency_seconds", "E2E scheduling latency")
+    Histogram("e2e_scheduling_latency_seconds", "E2E scheduling latency",
+              buckets=MS_BUCKETS)
 )
 plugin_scheduling_latency = REGISTRY.register(
     Histogram("plugin_scheduling_latency_seconds", "Plugin latency"),
     ("plugin", "OnSession"),
 )
 action_scheduling_latency = REGISTRY.register(
-    Histogram("action_scheduling_latency_seconds", "Action latency"), ("action",)
+    Histogram("action_scheduling_latency_seconds", "Action latency",
+              buckets=MS_BUCKETS),
+    ("action",),
 )
 task_scheduling_latency = REGISTRY.register(
     Histogram("task_scheduling_latency_seconds", "Task scheduling latency")
@@ -217,6 +278,7 @@ solver_phase_latency = REGISTRY.register(
     Histogram(
         "solver_phase_latency_seconds",
         "allocate_tpu per-phase latency (tensorize/solve/apply/epilogue)",
+        buckets=MS_BUCKETS,
     ),
     ("phase",),
 )
@@ -345,6 +407,48 @@ unschedulable_tasks = REGISTRY.register(
     ),
     ("reason",),
 )
+# Long-horizon telemetry watermarks (kube_batch_tpu/obs/telemetry.py):
+# the Prometheus face of the per-cycle watermark probes the soak-mode
+# leak detectors fit trends on. Gauges, updated once per cycle.
+process_rss_bytes = REGISTRY.register(
+    Gauge("process_rss_bytes", "Scheduler process resident set size")
+)
+jax_device_memory_bytes = REGISTRY.register(
+    Gauge(
+        "jax_device_memory_bytes",
+        "Live device memory across local jax devices (0 when the "
+        "backend exposes no memory_stats, e.g. CPU)",
+    )
+)
+metrics_label_series = REGISTRY.register(
+    Gauge(
+        "metrics_label_series",
+        "Label sets held across this registry — unbounded growth here "
+        "is a label-cardinality leak (per-job series must be GC'd on "
+        "job deletion)",
+    )
+)
+telemetry_windows_rolled = REGISTRY.register(
+    Gauge(
+        "telemetry_windows_rolled",
+        "Telemetry rollup windows closed since start",
+    )
+)
+telemetry_ring_occupancy = REGISTRY.register(
+    Gauge(
+        "telemetry_ring_occupancy",
+        "Per-cycle samples currently held in the telemetry raw ring",
+    )
+)
+queue_fairness_drift = REGISTRY.register(
+    Gauge(
+        "queue_fairness_drift",
+        "Per-queue (allocated - deserved) on the dominant dimension as "
+        "a fraction of cluster capacity; sustained positive drift "
+        "means a queue is being over-served",
+    ),
+    ("queue",),
+)
 
 
 # Update helpers (reference metrics.go:122-170).
@@ -392,6 +496,19 @@ def update_unschedulable_job_count(count: int) -> None:
 
 def register_job_retries(job_id: str) -> None:
     job_retry_count.inc((job_id,))
+
+
+def forget_job(job_id: str) -> None:
+    """Label-set GC for a deleted job: drop its per-job series from
+    the gauges/counters keyed on ``job_id``. Without this, every job
+    that ever went unschedulable leaves an immortal series behind —
+    an unbounded-cardinality leak over a production-length run (the
+    soak detector watches ``metrics_label_series`` for exactly this).
+    Called from the cache's job-cleanup path."""
+    if not job_id:
+        return
+    unschedule_task_count.remove((job_id,))
+    job_retry_count.remove((job_id,))
 
 
 def update_solver_cycle(rounds: int, backend: str) -> None:
@@ -483,6 +600,43 @@ def update_unschedulable_reasons(counts: dict) -> None:
     for reason in counts:
         if reason not in ALL_REASONS:  # defensive: unknown classifier
             unschedulable_tasks.set(float(counts[reason]), (reason,))
+
+
+def update_telemetry_watermarks(
+    values: dict, raw_occupancy: int = 0, windows_rolled: int = 0,
+    fairness_ran: bool = False,
+) -> None:
+    """Push one telemetry cycle's watermark probes to the gauges
+    (obs/telemetry.py feeds this once per scheduling cycle)."""
+    rss = values.get("rss_bytes")
+    if rss is not None:
+        process_rss_bytes.set(float(rss))
+    jax_device_memory_bytes.set(
+        float(values.get("jax_device_memory_bytes", 0.0))
+    )
+    series = values.get("metrics_series")
+    if series is not None:
+        metrics_label_series.set(float(series))
+    telemetry_windows_rolled.set(float(windows_rolled))
+    telemetry_ring_occupancy.set(float(raw_occupancy))
+    fairness = {
+        key.split(":", 1)[1]: float(v)
+        for key, v in values.items()
+        if key.startswith("fairness_drift:")
+    }
+    if fairness_ran:
+        # The amortized probe reports every live queue at once, so a
+        # gauge series outside the incoming set belongs to a deleted
+        # queue — drop it (same label-GC contract as forget_job: a
+        # stale {queue=...} series is exactly the cardinality-leak
+        # shape the soak detector fits growth on). Gated on the probe
+        # having RUN, not on a non-empty result: an empty dict (fewer
+        # than two live queues) means every existing series is stale.
+        for labels in queue_fairness_drift.label_sets():
+            if labels and labels[0] not in fairness:
+                queue_fairness_drift.remove(labels)
+        for queue, v in fairness.items():
+            queue_fairness_drift.set(v, (queue,))
 
 
 def register_sim_cycle() -> None:

@@ -15,15 +15,24 @@ observability.md):
   exhaustion / queue-overused / preempt-reclaim outcomes), behind the
   ``tpu_batch_unschedulable_tasks`` metric, ``/debug/jobs/<ns>/<name>``
   and ``python -m kube_batch_tpu explain``.
+- ``telemetry``: long-horizon per-cycle time-series (raw ring + rollup
+  windows with count/sum/min/max/quantile-sketch per key) fed from the
+  flight record plus resource-watermark probes; served by
+  ``/debug/timeseries``, embedded in flight dumps, and consumed by the
+  simulator's soak-mode leak/drift detectors (``sim/soak.py``).
 """
 
 from .flightrecorder import RECORDER, FlightRecorder, install_sigusr1
+from .telemetry import TELEMETRY, QuantileSketch, Telemetry
 from .tracer import TRACER, Tracer, export_trace, span, trace_dir_from_env
 
 __all__ = [
     "RECORDER",
     "FlightRecorder",
+    "QuantileSketch",
+    "TELEMETRY",
     "TRACER",
+    "Telemetry",
     "Tracer",
     "export_trace",
     "install_sigusr1",

@@ -191,7 +191,7 @@ class FlightRecorder:
         return records
 
     def dump(self, reason: str = "on-demand") -> dict:
-        return {
+        out = {
             "type": "flightrecorder",
             "version": DUMP_VERSION,
             "reason": reason,
@@ -202,6 +202,19 @@ class FlightRecorder:
             "cycle_errors": self.error_count,
             "records": _jsonable(self.snapshot()),
         }
+        # Trajectory context rides along with the per-cycle forensics:
+        # the newest telemetry rollup windows (obs/telemetry.py) say
+        # whether the dumped cycles sit on a flat line or a trend.
+        try:
+            from .telemetry import TELEMETRY
+
+            if TELEMETRY.cycles_observed:
+                out["telemetry"] = _jsonable(
+                    TELEMETRY.snapshot(recent_raw=32, recent_windows=64)
+                )
+        except Exception:  # pragma: no cover - dump must never fail
+            logger.exception("telemetry embed in flight dump failed")
+        return out
 
     def dump_json(self, reason: str = "on-demand") -> str:
         """Canonical JSON (sorted keys) of the whole ring."""
@@ -210,8 +223,18 @@ class FlightRecorder:
     def dump_to(self, path: str, reason: str = "on-demand") -> str:
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
-        with open(path, "w") as f:
+        # Write-then-rename: dumps are picked up by pollers (the
+        # SIGUSR1 workflow watches the directory for the dump name)
+        # which must never see a half-written file. The scratch name is
+        # a dotfile carrying neither the reason nor the target name so
+        # name-based watchers cannot match it.
+        tmp = os.path.join(
+            parent,
+            f".flightdump-{os.getpid()}-{threading.get_ident()}.tmp",
+        )
+        with open(tmp, "w") as f:
             f.write(self.dump_json(reason) + "\n")
+        os.replace(tmp, path)
         return path
 
     def dump_on_error(self, directory: Optional[str] = None) -> Optional[str]:

@@ -87,6 +87,10 @@ class Scheduler:
         # KBT_TRACE_DIR arms the span tracer for the whole loop; the
         # trace file is written on loop exit and on cycle errors.
         maybe_enable_from_env()
+        # Per-cycle telemetry feed (KBT_TELEMETRY=0 disables).
+        from .obs.telemetry import telemetry_enabled_from_env
+
+        self._telemetry = telemetry_enabled_from_env()
         confstr = scheduler_conf or DEFAULT_SCHEDULER_CONF
         if "\n" not in confstr and confstr.endswith((".yaml", ".yml")):
             with open(confstr) as f:
@@ -228,4 +232,14 @@ class Scheduler:
         e2e = time.perf_counter() - cycle_start
         metrics.update_e2e_duration(e2e)
         RECORDER.phase("done")
-        RECORDER.end_cycle(e2e_ms=round(e2e * 1e3, 3))
+        rec = RECORDER.end_cycle(e2e_ms=round(e2e * 1e3, 3))
+        # Long-horizon telemetry: fold this cycle's record + resource
+        # watermarks into the time-series (obs/telemetry.py). Guarded —
+        # a probe failure must never fail a cycle.
+        if self._telemetry:
+            try:
+                from .obs.telemetry import TELEMETRY
+
+                TELEMETRY.observe_scheduler_cycle(rec, cache=self.cache)
+            except Exception:
+                logger.exception("telemetry cycle feed failed")

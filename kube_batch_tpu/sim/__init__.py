@@ -20,17 +20,21 @@ from .clock import RealClock, VirtualClock
 from .faults import FaultInjector, SimBindFailure, parse_fault_spec
 from .harness import ClusterSimulator, SimConfig, SimReport
 from .invariants import InvariantChecker, Violation
+from .soak import DetectorResult, SoakVerdict, run_detectors
 from .trace import TraceReader, TraceWriter, placement_counts
 from .workload import WorkloadGenerator, WorkloadSpec
 
 __all__ = [
     "ClusterSimulator",
+    "DetectorResult",
     "FaultInjector",
     "InvariantChecker",
     "RealClock",
     "SimBindFailure",
     "SimConfig",
     "SimReport",
+    "SoakVerdict",
+    "run_detectors",
     "TraceReader",
     "TraceWriter",
     "VirtualClock",

@@ -2,7 +2,8 @@
 
 Exit codes: 0 clean; 1 invariant violations (always — a sim run that
 breaks the contract must fail CI); 2 replay placement mismatch;
-3 scheduler-cycle errors with ``--fail-on-cycle-errors``.
+3 scheduler-cycle errors with ``--fail-on-cycle-errors``; 4 soak-mode
+leak/drift detector trip (``--soak``).
 """
 
 from __future__ import annotations
@@ -55,6 +56,21 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
         "--replay", default=None, metavar="PATH",
         help="replay a recorded trace instead of generating events; "
              "per-cycle placements are verified against the recording")
+    parser.add_argument(
+        "--replay-cycles", type=int, default=None, metavar="N",
+        help="with --replay: stop after the first N recorded cycles "
+             "(the soak detectors' replay-bisect entry point)")
+    parser.add_argument(
+        "--soak", action="store_true",
+        help="long-horizon soak mode: record per-cycle telemetry "
+             "(resource watermarks, fairness drift), run the "
+             "leak/drift detectors over the rollup windows at the "
+             "end, dump the telemetry next to the trace (or to "
+             "--telemetry-out), and exit 4 on any detector trip")
+    parser.add_argument(
+        "--telemetry-out", default=None, metavar="PATH",
+        help="with --soak: write the telemetry windows + detector "
+             "verdict JSON here (default: <trace>.telemetry.json)")
     parser.add_argument("--no-check", dest="check", action="store_false",
                         default=True, help="skip the invariant checker")
     parser.add_argument("--fail-on-cycle-errors", action="store_true",
@@ -94,7 +110,10 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         trace_path=ns.trace,
         trace_out=ns.trace_out,
         replay=replay,
+        replay_limit=ns.replay_cycles,
         check_invariants=ns.check,
+        soak=ns.soak,
+        telemetry_out=ns.telemetry_out,
     )
 
 
@@ -137,4 +156,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 3
+    if report.soak and report.soak.get("tripped"):
+        print(
+            f"sim: soak detector(s) tripped: "
+            f"{', '.join(report.soak['tripped'])}",
+            file=sys.stderr,
+        )
+        for hint in report.soak.get("replay_bisect", []):
+            print(f"sim:   {hint}", file=sys.stderr)
+        return 4
     return 0

@@ -174,6 +174,24 @@ class FaultInjector:
             self._doomed = set(doomed_nodes)
             self._killed_mid_cycle = set()
 
+    def prune_bind_attempts(self, live_uids) -> int:
+        """Drop per-pod bind-attempt counters for pods that no longer
+        exist. A dead pod's counter is unreachable: its uid never binds
+        again (the controller analog recreates killed pods under
+        generation-suffixed names — ``<base>r<gen>``, harness
+        ``_schedule_recreate`` — so a uid, once dead, never recurs),
+        so pruning cannot change any fault decision — but
+        keeping them leaks one dict entry + uid string per pod that
+        ever bound, forever (the soak leak detector found this as a
+        perfectly linear alloc_blocks climb). The harness calls this at
+        a deterministic barrier with the settled cluster's live uids."""
+        live = set(live_uids)
+        with self._lock:
+            dead = [u for u in self._bind_attempts if u not in live]
+            for uid in dead:
+                del self._bind_attempts[uid]
+        return len(dead)
+
     def end_cycle(self) -> dict:
         """Disarm and drain the cycle's bind-seam forensics."""
         with self._lock:
@@ -202,10 +220,16 @@ class FaultInjector:
             if kill_node:
                 self._killed_mid_cycle.add(hostname)
             if not doomed:
+                p = self.spec.get("bind", 0.0)
+                if p <= 0:
+                    # No bind faults configured: do not even track the
+                    # attempt counter — it is only hash input, and a
+                    # per-pod-uid dict entry on every bind is a leak
+                    # over a 100k-cycle soak.
+                    return
                 attempt = self._bind_attempts.get(pod.uid, 0)
                 self._bind_attempts[pod.uid] = attempt + 1
-                p = self.spec.get("bind", 0.0)
-                fail = p > 0 and _hash01(
+                fail = _hash01(
                     self.seed, "bind", pod.uid, attempt
                 ) < p
                 if not fail:

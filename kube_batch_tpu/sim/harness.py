@@ -80,11 +80,21 @@ class SimConfig:
     period: float = 1.0             # virtual seconds per cycle
     trace_path: Optional[str] = None
     replay: Optional[TraceReader] = None
+    # Replay only the first N recorded cycles (soak replay-bisect:
+    # reproduce the state just past a detector's suspect window).
+    replay_limit: Optional[int] = None
     check_invariants: bool = True
     recreate_killed: bool = True    # controller analog for killed pods
     # Chrome trace-event export of the whole run (--trace-out): spans
     # carry the virtual clock's timestamp in their args.
     trace_out: Optional[str] = None
+    # Soak mode (--soak): telemetry records every cycle (window size
+    # scaled so the whole horizon fits the window ring), and the
+    # leak/drift detectors (sim/soak.py) run over the rolled windows
+    # at the end; their verdict lands in report.soak and the telemetry
+    # windows are dumped next to the trace (or to telemetry_out).
+    soak: bool = False
+    telemetry_out: Optional[str] = None
 
 
 @dataclass
@@ -105,6 +115,9 @@ class SimReport:
     # Chrome trace path, when armed.
     flight_dumps: List[str] = field(default_factory=list)
     trace_out: Optional[str] = None
+    # Soak-mode verdict (sim/soak.py): detector results, tripped series,
+    # the telemetry dump path, and replay-bisect hints.
+    soak: Optional[dict] = None
 
     @property
     def cycles_per_sec(self) -> float:
@@ -128,6 +141,7 @@ class SimReport:
             "invariant_check_seconds": round(self.check_seconds, 3),
             "flight_dumps": list(self.flight_dumps),
             "trace_out": self.trace_out,
+            **({"soak": self.soak} if self.soak is not None else {}),
         }
 
 
@@ -163,6 +177,8 @@ class ClusterSimulator:
             cfg.faults = header.get("faults", cfg.faults)
             cfg.period = header.get("period", cfg.period)
             cfg.cycles = len(cfg.replay.cycles)
+            if cfg.replay_limit is not None:
+                cfg.cycles = min(cfg.cycles, max(1, cfg.replay_limit))
         self.cfg = cfg
         self.clock = VirtualClock()
         # Validate BEFORE mutating process state: a bad fault spec must
@@ -192,7 +208,12 @@ class ClusterSimulator:
                 clock=self.clock,
             )
             self.checker = InvariantChecker()
-            self.writer = TraceWriter(cfg.trace_path)
+            # Soak runs stream the trace to disk without the in-memory
+            # record list (O(cycles) RAM the leak detector would —
+            # correctly — flag as a linear alloc_blocks climb).
+            self.writer = TraceWriter(
+                cfg.trace_path, retain=not cfg.soak
+            )
             self.replaying = cfg.replay is not None
             if self.replaying:
                 self.generator = None
@@ -205,6 +226,19 @@ class ClusterSimulator:
             raise
 
         self.report = SimReport()
+        # Soak mode: telemetry records every cycle; size the rollup
+        # window so the WHOLE horizon fits the window ring (100k cycles
+        # at /512 → ~195-cycle windows, 512 windows resident), and
+        # force-enable the scheduler's per-cycle feed.
+        if cfg.soak:
+            from ..obs.telemetry import TELEMETRY
+
+            TELEMETRY.configure(
+                window_cycles=max(4, cfg.cycles // 512),
+                max_windows=1024,
+                raw_capacity=512,
+            )
+            self.scheduler._telemetry = True
         # Chrome-trace export of the run: enable the global tracer and
         # stamp every span with the virtual clock, so the exported
         # timeline can be correlated with trace-cycle records.
@@ -270,6 +304,8 @@ class ClusterSimulator:
                 self._run_cycle(cycle)
                 self.clock.advance(cfg.period)
             self.report.cycles = cfg.cycles
+            if cfg.soak:
+                self._finish_soak()
         finally:
             self.report.wall_seconds = time.perf_counter() - started
             self.close()
@@ -389,6 +425,14 @@ class ClusterSimulator:
         # 4. barrier + deterministic queue drains
         self._settle()
         seam = self.injector.end_cycle()
+        if cycle % 256 == 255:
+            # Periodic deterministic GC of dead pods' bind-attempt
+            # counters (leak over long soaks; dead uids never bind
+            # again so pruning changes no fault decision). Runs on the
+            # settled cluster, so record and replay prune identically.
+            self.injector.prune_bind_attempts(
+                p.uid for p in self.cluster.list_objects("Pod")
+            )
         for pod_key, _host in seam["bind_failures"]:
             self._degrade_pod(pod_key, cycle)
         self.report.bind_failures += len(seam["bind_failures"])
@@ -433,6 +477,33 @@ class ClusterSimulator:
         metrics.register_sim_cycle()
         self.report.placements += len(placements)
 
+        stats = self._cycle_stats()
+        if cfg.soak:
+            # Soak-only series: invariant/error counts (bounded at zero
+            # by the drift detectors) and the cluster's population —
+            # folded into the cycle's open telemetry window, which
+            # run_once already started with the watermark probes.
+            from ..obs.telemetry import TELEMETRY
+
+            if not ok:
+                # An errored cycle never reaches run_once's telemetry
+                # feed, so the series' internal cycle counter would
+                # drift from the trace's cycle numbers — and with it
+                # every replay-bisect pointer. Feed the missing sample
+                # at the true trace cycle; the explicit index also
+                # realigns the counter for all later cycles.
+                TELEMETRY.observe_values({}, cycle=cycle)
+            TELEMETRY.annotate_cycle({
+                "invariant_violations": float(len(violations)),
+                "sim_cycle_errors": 0.0 if ok else 1.0,
+                "placements": float(len(placements)),
+                "pods": float(stats["pods"]),
+                "pending": float(stats["pending"]),
+                "running": float(stats["running"]),
+                "nodes": float(stats["nodes"]),
+                "jobs": float(stats["jobs"]),
+            })
+
         record = {
             "type": "cycle",
             "cycle": cycle,
@@ -441,13 +512,61 @@ class ClusterSimulator:
             "post_events": post_events,
             "placements": placements,
             "bind_failures": [list(b) for b in seam["bind_failures"]],
-            "stats": self._cycle_stats(),
+            "stats": stats,
             "violations": violations,
         }
         self.writer.write(record)
         if self.replaying and rec is not None:
             if placements != rec.get("placements", []):
                 self.report.replay_mismatches.append(cycle)
+
+    def _finish_soak(self) -> None:
+        """End of a soak run: close the tail window, fit the leak/drift
+        detectors over the rolled windows, dump the telemetry
+        (alongside the JSONL trace, or to --telemetry-out), and land
+        the verdict in the report. Detector trips do NOT raise — the
+        CLI turns them into exit code 4 so the report still prints."""
+        import json as _json
+
+        from ..obs.telemetry import TELEMETRY
+        from .soak import SoakVerdict, run_detectors
+
+        TELEMETRY.flush()
+        windows = TELEMETRY.windows()
+        verdict = SoakVerdict(
+            detectors=run_detectors(windows),
+            trace_path=self.cfg.trace_path,
+        )
+        dump_path = self.cfg.telemetry_out or (
+            f"{self.cfg.trace_path}.telemetry.json"
+            if self.cfg.trace_path else None
+        )
+        if dump_path:
+            try:
+                # Set before to_dict so the on-disk dump names itself;
+                # reset if the write fails.
+                verdict.telemetry_dump = dump_path
+                payload = TELEMETRY.snapshot(recent_raw=128)
+                payload["soak"] = verdict.to_dict()
+                payload["config"] = {
+                    "cycles": self.cfg.cycles,
+                    "seed": self.cfg.seed,
+                    "faults": self.cfg.faults,
+                    "backend": self.cfg.backend,
+                    "workload": self.cfg.workload.to_dict(),
+                }
+                parent = os.path.dirname(os.path.abspath(dump_path))
+                os.makedirs(parent, exist_ok=True)
+                with open(dump_path, "w") as f:
+                    _json.dump(payload, f, sort_keys=True)
+            except OSError:
+                verdict.telemetry_dump = None
+                logger.exception("soak telemetry dump failed")
+        self.report.soak = verdict.to_dict()
+        for trip in verdict.tripped:
+            logger.error("soak detector tripped: %s", trip.message)
+        for hint in verdict.replay_hints():
+            logger.error("soak replay-bisect: %s", hint)
 
     def _flight_dump(self, cycle: int, reason: str) -> None:
         """Write the flight-recorder ring next to the JSONL trace (no-op

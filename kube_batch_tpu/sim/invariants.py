@@ -307,15 +307,25 @@ class InvariantChecker:
             # crosses the line. What may never happen: a queue ALREADY
             # past deserved (+ one-gang slack for deserved drift under
             # mid-cycle churn) receiving MORE allocation.
+            #
+            # "Already past" must mirror the plugin's OverusedFn
+            # contract (proportion.py:198-208 analog): a queue is
+            # overused only when allocated covers deserved in EVERY
+            # dimension. A cpu-saturated/memory-light queue is NOT
+            # overused and may keep gaining cpu — the 100k-cycle soak
+            # caught the earlier any-dimension form of this check
+            # flagging exactly that (105 false violations, ~1/1000
+            # cycles under a cpu-bound mix).
             bound = deserved[q].clone()
             bound.add(max_gang[q])
-            already_over = _exceeds(prev, bound, self.eps)
+            already_over = bound.less_equal(prev)
             gained = _exceeds(allocated[q], prev, self.eps)
             if already_over and gained:
+                over_dims = _exceeds(prev, bound, self.eps)
                 flag(
                     "queue-share", q,
-                    f"queue already past deserved + one gang "
-                    f"({already_over}) still gained allocation; "
+                    f"queue already past deserved + one gang in every "
+                    f"dimension ({over_dims}) still gained allocation; "
                     f"deserved={_dims(deserved[q])}",
                 )
         self._prev_queue_alloc = {

@@ -33,16 +33,28 @@ def canon(obj) -> str:
 
 class TraceWriter:
     """Append-only JSONL writer; ``None`` path → in-memory only (the
-    records list is kept either way, so the harness can hand the run's
-    trace to a replay without touching disk)."""
+    records list is kept by default, so the harness can hand the run's
+    trace to a replay without touching disk).
 
-    def __init__(self, path: Optional[str] = None):
+    ``retain=False`` drops the in-memory copy (streaming to disk when a
+    path is given, keeping nothing when not): a 100k-cycle soak
+    otherwise accumulates every cycle record in RAM — an unbounded
+    O(cycles) growth the soak leak detector itself flags (it shows up
+    as a perfectly-linear ``alloc_blocks`` climb), and that holds with
+    or without ``--trace``. Soak mode sets it; replays read the file
+    back through TraceReader."""
+
+    def __init__(self, path: Optional[str] = None, retain: bool = True):
         self.path = path
+        self.retain = retain
         self.records: List[dict] = []
+        self.written = 0
         self._fh = open(path, "w") if path else None
 
     def write(self, record: dict) -> None:
-        self.records.append(record)
+        self.written += 1
+        if self.retain:
+            self.records.append(record)
         if self._fh is not None:
             self._fh.write(canon(record) + "\n")
             self._fh.flush()

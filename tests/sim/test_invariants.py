@@ -41,8 +41,8 @@ def req(cpu="1", mem="1Gi"):
     return build_resource_list(cpu=cpu, memory=mem)
 
 
-def add_running(cache, name, node, cpu="1", group=None):
-    pod = build_pod("sim", name, node, PodPhase.RUNNING, req(cpu),
+def add_running(cache, name, node, cpu="1", mem="1Gi", group=None):
+    pod = build_pod("sim", name, node, PodPhase.RUNNING, req(cpu, mem),
                     group_name=group)
     cache.add_pod(pod)
     return pod
@@ -185,8 +185,10 @@ class TestQueueShares:
         c = make_cache()
         c.add_queue(build_queue("qa", weight=1))
         c.add_queue(build_queue("qb", weight=1))
-        c.add_node(build_node("n1", req("10", "100Gi")))
-        # qa: eight 1-CPU singletons running; qb: equal pending demand.
+        c.add_node(build_node("n1", req("10", "10Gi")))
+        # qa: eight singletons running, past its deserved half on BOTH
+        # dimensions (the plugin's OverusedFn contract is per-queue
+        # all-dims coverage); qb: equal pending demand.
         for i in range(8):
             c.add_pod_group(build_pod_group(f"a{i}", namespace="sim",
                                             min_member=1, queue="qa"))
@@ -199,11 +201,39 @@ class TestQueueShares:
         checker = InvariantChecker()
         # Baseline pass records per-queue allocation, flags nothing.
         assert checker.check(c, cycle=0) == []
-        # qa GAINS another singleton while already far past its
-        # deserved half -> the fairness contract is broken.
+        # qa GAINS another singleton while already past its deserved
+        # share in every dimension -> the fairness contract is broken.
         c.add_pod_group(build_pod_group("a9", namespace="sim",
                                         min_member=1, queue="qa"))
         add_running(c, "a9-0", "n1", group="a9")
         found = checker.check(c, cycle=1)
         assert kinds(found) == ["queue-share"]
         assert found[0].subject == "qa"
+
+    def test_single_dimension_overshoot_is_not_flagged(self):
+        """The reference OverusedFn blocks a queue only when allocated
+        covers deserved in EVERY dimension — a cpu-saturated but
+        memory-light queue legitimately keeps gaining cpu. The
+        100k-cycle soak caught the checker's earlier any-dimension
+        form flagging ~1/1000 cycles under a cpu-bound mix."""
+        c = make_cache()
+        c.add_queue(build_queue("qa", weight=1))
+        c.add_queue(build_queue("qb", weight=1))
+        c.add_node(build_node("n1", req("10", "100Gi")))
+        # qa far past deserved on cpu (8 of a deserved 5) but way
+        # under on memory (8 Gi of a deserved 50 Gi).
+        for i in range(8):
+            c.add_pod_group(build_pod_group(f"a{i}", namespace="sim",
+                                            min_member=1, queue="qa"))
+            add_running(c, f"a{i}-0", "n1", group=f"a{i}")
+        c.add_pod_group(build_pod_group("b0", namespace="sim",
+                                        min_member=8, queue="qb"))
+        for i in range(8):
+            c.add_pod(build_pod("sim", f"b0-{i}", "", PodPhase.PENDING,
+                                req(), group_name="b0"))
+        checker = InvariantChecker()
+        assert checker.check(c, cycle=0) == []
+        c.add_pod_group(build_pod_group("a9", namespace="sim",
+                                        min_member=1, queue="qa"))
+        add_running(c, "a9-0", "n1", group="a9")
+        assert checker.check(c, cycle=1) == []

@@ -1,0 +1,187 @@
+"""Bench regression sentinel (tools/bench_compare.py): canary
+normalization, thresholds, allowlist, and the committed-artifact
+acceptance pair (BENCH_r05 -> BENCH_r06 passes; an injected 20%
+cycle_ms regression fails)."""
+
+import copy
+import importlib.util
+import json
+import os
+
+import pytest
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     os.pardir, os.pardir)
+_TOOL = os.path.join(_REPO, "tools", "bench_compare.py")
+
+spec = importlib.util.spec_from_file_location("bench_compare", _TOOL)
+bc = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bc)
+
+R05 = os.path.join(_REPO, "BENCH_r05.json")
+R06 = os.path.join(_REPO, "BENCH_r06.json")
+ALLOW = os.path.join(_REPO, "tools", "bench_allowlist.json")
+
+
+def base_artifact(value=100.0):
+    return {
+        "metric": "m",
+        "value": value,
+        "native_greedy_ms": 1000.0,
+        "pods_placed": 50,
+        "cycle": {
+            "cold": {"cycle_ms": 500.0},
+            "steady": {"cycle_ms": 50.0},
+            "idle": {"cycle_ms": 10.0},
+            "delta": {"cycle_ms": 60.0},
+        },
+    }
+
+
+def test_same_machine_regression_flagged():
+    old = base_artifact()
+    new = copy.deepcopy(old)
+    new["cycle"]["idle"]["cycle_ms"] = 12.0  # +20%
+    report = bc.compare(old, new)
+    assert not report["ok"]
+    assert [r["key"] for r in report["regressions"]] == [
+        "cycle.idle.cycle_ms"
+    ]
+
+
+def test_improvement_and_noise_pass():
+    old = base_artifact()
+    new = copy.deepcopy(old)
+    new["value"] = 80.0                       # improvement
+    new["cycle"]["idle"]["cycle_ms"] = 11.0   # +10% < 15% threshold
+    assert bc.compare(old, new)["ok"]
+
+
+def test_canary_normalization_absorbs_machine_speed():
+    """A uniformly 3x slower machine (canary moved 3x too) is not a
+    regression; a 3x slowdown with a flat canary is."""
+    old = base_artifact()
+    slow = copy.deepcopy(old)
+    slow["native_greedy_ms"] = 3000.0
+    slow["value"] = 300.0
+    for s in slow["cycle"].values():
+        s["cycle_ms"] *= 3.0
+    report = bc.compare(old, slow)
+    assert report["canary_scale"] == 3.0
+    assert report["cross_machine"]
+    assert report["ok"], report["regressions"]
+
+    flat_canary = copy.deepcopy(slow)
+    flat_canary["native_greedy_ms"] = 1000.0
+    report = bc.compare(old, flat_canary)
+    assert not report["ok"]
+
+
+def test_canary_key_not_self_normalized():
+    """``greedy_small_ms`` is both a policy row and a canary: its own
+    row must be normalized by the OTHER canary, never by itself — a
+    self-normalized ratio is tautologically 1.0 and a pure-Python
+    greedy regression would be invisible (and would silently loosen
+    every other normalized threshold via the max-over-canaries
+    scale)."""
+    old = base_artifact()
+    old["greedy_small_ms"] = 800.0
+    new = copy.deepcopy(old)
+    new["greedy_small_ms"] = 1600.0  # 2x slower, native canary flat
+    report = bc.compare(old, new)
+    assert not report["ok"]
+    assert "greedy_small_ms" in [r["key"] for r in report["regressions"]]
+    row = next(r for r in report["rows"] if r["key"] == "greedy_small_ms")
+    assert row["normalized_ratio"] == 2.0
+    # Cross-machine: a uniformly 3x slower machine (BOTH canaries moved
+    # 3x) explains the greedy movement — not a regression. And a round
+    # where only the OTHER canary moved (the r06 contention-polluted
+    # native measurement) must not drag a flat greedy row into a false
+    # positive: the raw same-machine view explains it.
+    slow = copy.deepcopy(old)
+    slow["native_greedy_ms"] = 3000.0
+    slow["greedy_small_ms"] = 2400.0
+    assert bc.compare(old, slow)["ok"]
+    polluted = copy.deepcopy(old)
+    polluted["native_greedy_ms"] = 250.0   # native 4x "faster"
+    polluted["greedy_small_ms"] = 790.0    # greedy flat (raw ~0.99)
+    report = bc.compare(old, polluted)
+    assert "greedy_small_ms" not in [
+        r["key"] for r in report["regressions"]
+    ]
+
+
+def test_count_must_not_drop():
+    old = base_artifact()
+    new = copy.deepcopy(old)
+    new["pods_placed"] = 49
+    report = bc.compare(old, new)
+    assert [r["key"] for r in report["regressions"]] == ["pods_placed"]
+
+
+def test_allowlist_globs_and_reasons():
+    old = base_artifact()
+    new = copy.deepcopy(old)
+    new["cycle"]["steady"]["cycle_ms"] = 200.0
+    report = bc.compare(old, new, allowed={
+        "cycle.steady.*": "intentional: tracked in ROADMAP"
+    })
+    assert report["ok"]
+    assert report["allowed"][0]["key"] == "cycle.steady.cycle_ms"
+    assert "ROADMAP" in report["allowed"][0]["allow_reason"]
+
+
+def test_allowlist_file_requires_reason(tmp_path):
+    bad = tmp_path / "allow.json"
+    bad.write_text(json.dumps([{"key": "value"}]))
+    with pytest.raises(ValueError):
+        bc.load_allowlist(str(bad), [])
+
+
+def test_missing_keys_skipped_not_failed():
+    old = {"metric": "m", "value": 100.0}
+    new = {"metric": "m", "value": 90.0}
+    report = bc.compare(old, new)
+    assert report["ok"]
+    skipped = [r for r in report["rows"] if r["status"] == "skipped"]
+    assert skipped  # everything but `value`
+
+
+def test_parsed_wrapper_unwrapped():
+    data = bc.load_bench(R05)
+    assert data["metric"].startswith("gang-cycle")
+
+
+def test_committed_r05_r06_passes_with_allowlist():
+    """The acceptance pair: the two committed artifacts, the committed
+    allowlist — must pass (the steady-cycle regression is the one
+    ALLOWED entry)."""
+    allowed = bc.load_allowlist(ALLOW, [])
+    report = bc.compare(bc.load_bench(R05), bc.load_bench(R06),
+                        allowed=allowed)
+    assert report["ok"], report["regressions"]
+    assert [r["key"] for r in report["allowed"]] == [
+        "cycle.steady.cycle_ms"
+    ]
+
+
+def test_committed_r05_r06_fails_without_allowlist():
+    """The allowlist is load-bearing: the steady regression is real."""
+    report = bc.compare(bc.load_bench(R05), bc.load_bench(R06))
+    assert not report["ok"]
+    assert [r["key"] for r in report["regressions"]] == [
+        "cycle.steady.cycle_ms"
+    ]
+
+
+def test_injected_regression_flagged_cli():
+    """The CI self-test path: 20% cycle_ms injection must exit 0 from
+    --self-test (which internally asserts the injection IS flagged)."""
+    rc = bc.main([R05, R06, "--self-test", "--allow-file", ALLOW])
+    assert rc == 0
+
+
+def test_cli_exit_codes(tmp_path):
+    assert bc.main([R05, R06, "--allow-file", ALLOW]) == 0
+    assert bc.main([R05, R06]) == 1
+    assert bc.main(["/nonexistent.json", R06]) == 2

@@ -1,0 +1,127 @@
+"""Label-set GC: per-job metric series must die with the job.
+
+The regression this pins: ``unschedule_task_count`` /
+``job_retry_counts`` are labeled by job name and were set every cycle a
+gang was unschedulable — but nothing ever removed the label set when
+the job was deleted, so the registry's cardinality grew monotonically
+with job churn (the soak detector's ``metrics_series`` watermark
+flags exactly this shape of leak)."""
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api import PodPhase, build_resource_list
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.utils.test_utils import (
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+
+def test_metric_remove_primitive():
+    g = metrics.Gauge("t_gc_gauge")
+    g.set(3.0, ("a",))
+    g.set(4.0, ("b",))
+    assert g.series_count() == 2
+    assert g.remove(("a",)) is True
+    assert g.remove(("a",)) is False
+    assert g.series_count() == 1 and g.get(("b",)) == 4.0
+
+    c = metrics.Counter("t_gc_counter")
+    c.inc(("x",))
+    assert c.remove(("x",)) is True and c.series_count() == 0
+
+    h = metrics.Histogram("t_gc_hist")
+    h.observe(0.5, ("y",))
+    assert h.series_count() == 1
+    assert h.remove(("y",)) is True
+    assert h.count(("y",)) == 0 and h.sum(("y",)) == 0.0
+
+
+def test_forget_job_drops_both_series():
+    metrics.update_unschedulable_task_count("gcjob-a", 4)
+    metrics.register_job_retries("gcjob-a")
+    text = metrics.REGISTRY.expose_text()
+    assert 'job_id="gcjob-a"' in text
+    before = metrics.REGISTRY.series_count()
+    metrics.forget_job("gcjob-a")
+    assert 'gcjob-a' not in metrics.REGISTRY.expose_text()
+    assert metrics.REGISTRY.series_count() == before - 2
+    metrics.forget_job("gcjob-a")  # idempotent
+    metrics.forget_job("")         # no-op
+
+
+def test_job_deletion_gcs_label_series():
+    """End to end through the cache: job goes unschedulable (its
+    per-job series exist), the job is deleted, the cleanup drain must
+    take the label sets with it."""
+    cache = SchedulerCache()
+    cache.add_queue(build_queue("default", weight=1))
+    pg = build_pod_group("gcjob-e2e", namespace="t", min_member=2,
+                         queue="default")
+    cache.add_pod_group(pg)
+    pod = build_pod(
+        "t", "gcjob-e2e-0", "", PodPhase.PENDING,
+        build_resource_list(cpu="1", memory="1Gi"),
+        group_name="gcjob-e2e",
+    )
+    cache.add_pod(pod)
+    # What the gang plugin does at session close for an unready gang.
+    metrics.update_unschedulable_task_count("gcjob-e2e", 2)
+    metrics.register_job_retries("gcjob-e2e")
+    assert 'gcjob-e2e' in metrics.REGISTRY.expose_text()
+
+    cache.delete_pod(pod)
+    cache.delete_pod_group(pg)
+    removed = cache.drain_cleanup_queue()
+    assert removed == 1
+    assert 'gcjob-e2e' not in metrics.REGISTRY.expose_text()
+    cache.shutdown()
+
+
+def test_live_job_series_survive_unrelated_cleanup():
+    """GC must be per-job: deleting job A leaves job B's series."""
+    cache = SchedulerCache()
+    cache.add_queue(build_queue("default", weight=1))
+    for name in ("gcjob-x", "gcjob-y"):
+        pg = build_pod_group(name, namespace="t", min_member=1,
+                             queue="default")
+        cache.add_pod_group(pg)
+        metrics.update_unschedulable_task_count(name, 1)
+    # Delete only gcjob-x.
+    pg_x = build_pod_group("gcjob-x", namespace="t", min_member=1,
+                           queue="default")
+    cache.delete_pod_group(pg_x)
+    cache.drain_cleanup_queue()
+    text = metrics.REGISTRY.expose_text()
+    assert 'gcjob-x' not in text
+    assert 'gcjob-y' in text
+    metrics.forget_job("gcjob-y")  # leave the registry clean
+    cache.shutdown()
+
+
+def test_fairness_gauge_prunes_deleted_queues():
+    """queue_fairness_drift label series die with the queue: each run
+    of the fairness probe reports every live queue, so anything outside
+    the incoming set is stale and must be swept — gated on the probe
+    having RUN (``fairness_ran``), not on a non-empty result."""
+    from kube_batch_tpu.metrics.metrics import queue_fairness_drift as g
+    metrics.update_telemetry_watermarks({
+        "fairness_drift:alpha": 0.1,
+        "fairness_drift:beta": -0.2,
+    }, fairness_ran=True)
+    assert g.get(("alpha",)) == 0.1 and g.get(("beta",)) == -0.2
+    # An amortized off-cycle (probe did not run) must not sweep
+    # anything, fairness keys absent or not.
+    metrics.update_telemetry_watermarks({"rss_bytes": 1.0})
+    assert ("beta",) in g.label_sets()
+    # beta deleted: next probe run omits it -> series removed.
+    metrics.update_telemetry_watermarks(
+        {"fairness_drift:alpha": 0.3}, fairness_ran=True
+    )
+    assert g.get(("alpha",)) == 0.3
+    assert ("beta",) not in g.label_sets()
+    # The probe ran but reported NO queues (fewer than two live): every
+    # remaining series is stale and must die too — the sweep cannot
+    # hide behind an empty result.
+    metrics.update_telemetry_watermarks({}, fairness_ran=True)
+    assert g.label_sets() == []
