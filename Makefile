@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke bench-compare verify ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke bench-compare verify ci image clean
 
 all: native
 
@@ -13,8 +13,11 @@ native:
 	$(MAKE) -C kube_batch_tpu/native/csrc
 
 # Unit + action + solver + e2e suites on the virtual CPU mesh.
+# Long-horizon soaks (@pytest.mark.slow, e.g. the 2k-cycle chaos
+# acceptance storm) are excluded here — run them explicitly with
+# `pytest -m slow`.
 test:
-	$(PY) -m pytest tests/ -x -q
+	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 e2e:
 	$(PY) -m pytest tests/e2e -x -q
@@ -69,6 +72,18 @@ soak-smoke:
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim --cycles 2000 --seed 3 \
 		--backend native --soak --quiet
 
+# Chaos smoke: a seeded fault storm through the DEVICE solve path
+# (backend dense, so the containment ladder — not the native
+# default-route — absorbs the injected solver exceptions/hangs). The
+# CLI exits 1 on any invariant violation and 3 on any cycle error
+# (--fail-on-cycle-errors): a wedge or an uncontained device fault
+# fails the build. doc/design/robustness.md.
+chaos-smoke:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim --cycles 250 --seed 11 \
+		--backend dense \
+		--faults "solver-exc:0.08,solver-hang:0.02,bind:0.05" \
+		--fail-on-cycle-errors --quiet
+
 # Bench regression sentinel across the two newest committed bench
 # rounds (noise-aware: canary-normalized thresholds + the explicit
 # allowlist), THEN its own self-test: an injected 20% cycle_ms
@@ -102,7 +117,7 @@ verify:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify native test bench-smoke sim-smoke soak-smoke bench-compare
+ci: verify native test bench-smoke sim-smoke soak-smoke chaos-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

@@ -465,25 +465,47 @@ def bench_cycle(cfg, seed=0, cache=None, trace_path=None,
     rng = np.random.RandomState(seed + 1)
     new_groups = max(1, n_groups // 100)
     per_group = n_tasks // n_groups
-    for g in range(new_groups):
-        name = f"pgd{g}"
-        cache.add_pod_group(build_pod_group(
-            name, namespace="bench",
-            min_member=int(rng.randint(1, per_group + 1)),
-            queue=f"q{g % n_queues}",
-        ))
-        for i in range(per_group):
-            cache.add_pod(build_pod(
-                "bench", f"{name}-p{i}", "", PodPhase.PENDING,
-                build_resource_list(
-                    cpu=f"{int(rng.choice([250, 500, 1000, 2000, 4000]))}m",
-                    memory=f"{int(rng.choice([256, 512, 1024, 4096, 8192]))}Mi",
-                ),
-                group_name=name,
+
+    def add_burst(prefix):
+        for g in range(new_groups):
+            name = f"{prefix}{g}"
+            cache.add_pod_group(build_pod_group(
+                name, namespace="bench",
+                min_member=int(rng.randint(1, per_group + 1)),
+                queue=f"q{g % n_queues}",
             ))
+            for i in range(per_group):
+                cache.add_pod(build_pod(
+                    "bench", f"{name}-p{i}", "", PodPhase.PENDING,
+                    build_resource_list(
+                        cpu=f"{int(rng.choice([250, 500, 1000, 2000, 4000]))}m",
+                        memory=f"{int(rng.choice([256, 512, 1024, 4096, 8192]))}Mi",
+                    ),
+                    group_name=name,
+                ))
+
+    add_burst("pgd")
     delta = one_cycle()
     delta["spans"] = spans_since(mark)
-    out = {"cold": cold, "steady": steady, "idle": idle, "delta": delta}
+
+    # Degraded-mode floor: one more same-size burst cycle with the
+    # fault-containment breaker PINNED open (solver/containment.py) —
+    # the whole cycle runs on the native floor with zero device
+    # dispatch, exactly what an open breaker costs in production.
+    # bench_compare tracks this point like any headline number, so the
+    # floor's latency cannot silently regress.
+    from kube_batch_tpu.solver import containment
+
+    add_burst("pgx")
+    mark = TRACER.spans_recorded
+    containment.BREAKER.pin_open("bench-degraded")
+    try:
+        degraded = one_cycle()
+    finally:
+        containment.BREAKER.unpin()
+    degraded["spans"] = spans_since(mark)
+    out = {"cold": cold, "steady": steady, "idle": idle, "delta": delta,
+           "degraded": degraded}
     if tracing:
         out["trace_path"] = TRACER.export(trace_path)
         out["trace_spans"] = TRACER.spans_recorded

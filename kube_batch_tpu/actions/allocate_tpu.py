@@ -88,23 +88,46 @@ def _use_native_solver() -> bool:
         return False
 
 
+class _AbandonableWorker:
+    """One persistent single-slot executor that can be ABANDONED when
+    its occupant blows a deadline: the slot is wedged inside a foreign
+    blocking call (greedy.cpp via ctypes, or an XLA device→host sync)
+    that cannot be cancelled, so :meth:`abandon` detaches the pool (no
+    wait — the thread dies whenever the call returns, its result
+    unread) and the next submit lazily builds a fresh slot instead of
+    queueing behind the hang forever. A persistent worker, not a
+    thread per call: the block point is on the steady-cycle hot path
+    with a ~1% overhead budget."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._pool = None
+        self._lock = threading.Lock()
+
+    def submit(self, fn):
+        with self._lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix=self._name
+                )
+            return self._pool.submit(fn)
+
+    def abandon(self):
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+
 # Single worker for the native in-flight solve: the ctypes call into
 # greedy.cpp releases the GIL, so the scheduler thread's host work
 # genuinely overlaps the C++ rounds. One scheduler loop → one slot.
-_native_pool = None
-_native_pool_lock = threading.Lock()
+_NATIVE_WORKER = _AbandonableWorker("kbt-native-solve")
 
-
-def _native_executor():
-    global _native_pool
-    with _native_pool_lock:
-        if _native_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-
-            _native_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="kbt-native-solve"
-            )
-        return _native_pool
+# Deadline-bounded device→host syncs, same single-slot contract.
+_DEVICE_SYNC_WORKER = _AbandonableWorker("kbt-device-sync")
 
 
 class AsyncSolveHandle:
@@ -121,13 +144,20 @@ class AsyncSolveHandle:
     commit/discard and session close DRAIN it before touching the world
     the solve snapshotted — commit/discard semantics are unchanged: no
     transaction boundary can run concurrently with an outstanding
-    solve. ``fetch`` memoizes, so a guard-path drain never loses the
-    result the action still needs.
+    solve. ``fetch`` memoizes BOTH outcomes: the result, and — fault
+    containment — the failure, so a second fetch of a failed handle
+    re-raises a typed :class:`~..solver.containment.SolveFailed`
+    instead of hitting a consumed future.
+
+    ``fetch(timeout=...)`` is the solve deadline: on expiry the handle
+    is ABANDONED — the future/device result is detached, a late arrival
+    is discarded, and :class:`SolveTimeout` is raised (and memoized) so
+    the caller's degradation ladder re-solves on a lower rung.
     """
 
     __slots__ = (
         "backend", "rounds", "refills", "stages", "native_stats",
-        "_future", "_result", "_assigned",
+        "_future", "_result", "_assigned", "_error", "_fault_hook",
     )
 
     def __init__(self, backend: str):
@@ -142,10 +172,12 @@ class AsyncSolveHandle:
         self._future = None
         self._result = None
         self._assigned = None
+        self._error = None
+        self._fault_hook = None
 
     @classmethod
-    def launch(cls, inputs, use_native: bool, max_rounds: int
-               ) -> "AsyncSolveHandle":
+    def launch(cls, inputs, use_native: bool, max_rounds: int,
+               fault_hook=None) -> "AsyncSolveHandle":
         if use_native:
             handle = cls("native")
             from ..native import solve_native
@@ -159,11 +191,15 @@ class AsyncSolveHandle:
                 with TRACER.adopt(parent), span("native_solve"):
                     return solve_native(inputs)
 
-            handle._future = _native_executor().submit(traced_solve)
+            handle._future = _NATIVE_WORKER.submit(traced_solve)
             return handle
         import jax
 
         handle = cls(f"jax-{jax.devices()[0].platform}")
+        # Sim chaos seam (containment.device_fault_hook): consulted in
+        # the fetch-side materialization, where a raise/hang lands
+        # exactly where a real device fault would.
+        handle._fault_hook = fault_hook
         # solve_sharded shards the node axis over all visible devices
         # (the multi-chip scale path) and falls back to the cached
         # single-device jit when only one device exists. The call
@@ -174,7 +210,7 @@ class AsyncSolveHandle:
     def done(self) -> bool:
         """Non-blocking completion poll (best-effort on jax backends
         that do not expose buffer readiness)."""
-        if self._assigned is not None:
+        if self._assigned is not None or self._error is not None:
             return True
         if self._future is not None:
             return self._future.done()
@@ -183,33 +219,117 @@ class AsyncSolveHandle:
         except AttributeError:  # pragma: no cover - older jax
             return True
 
-    def fetch(self) -> np.ndarray:
-        """The block point: the assignment vector as a host array
-        (memoized — a second fetch is free)."""
+    def _fetch_native(self, timeout):
+        from ..solver.containment import SolveTimeout
+
+        if timeout is None:
+            assigned, _ = self._future.result()
+        else:
+            from concurrent.futures import TimeoutError as FutTimeout
+
+            try:
+                assigned, _ = self._future.result(timeout=timeout)
+            except FutTimeout as exc:
+                # The worker slot is stuck in a foreign call; give the
+                # next native solve a fresh executor and abandon this
+                # future (its late result is never read).
+                _NATIVE_WORKER.abandon()
+                raise SolveTimeout(
+                    f"native solve exceeded its {timeout:.3f}s budget; "
+                    f"worker abandoned"
+                ) from exc
+        self._assigned = np.asarray(assigned)
+        self.rounds = 1
+        from ..native.greedy import last_solve_stats
+
+        self.native_stats = dict(last_solve_stats)
+
+    def _fetch_jax(self, timeout):
+        from ..solver.containment import SolveTimeout
+
+        result, hook = self._result, self._fault_hook
+
+        def materialize():
+            if hook is not None:
+                hook("solve")
+            return np.asarray(result.assigned)
+
+        if timeout is None:
+            self._assigned = materialize()
+        else:
+            # Deadline-bounded device→host sync on the persistent
+            # single-worker executor (not a thread per cycle — this is
+            # the steady-cycle hot path): a hung XLA solve is abandoned
+            # at the budget (SolveTimeout) with its worker slot, its
+            # late result discarded unread.
+            from concurrent.futures import TimeoutError as FutTimeout
+
+            fut = _DEVICE_SYNC_WORKER.submit(materialize)
+            try:
+                self._assigned = fut.result(timeout=timeout)
+            except FutTimeout as exc:
+                _DEVICE_SYNC_WORKER.abandon()
+                raise SolveTimeout(
+                    f"{self.backend} solve exceeded its {timeout:.3f}s "
+                    f"budget; abandoned (late result will be discarded)"
+                ) from exc
+        self.rounds = int(result.rounds)
+        if result.refills is not None:
+            self.refills = int(result.refills)
+        if result.stages is not None:
+            self.stages = int(result.stages)
+
+    def fetch(self, timeout=None) -> np.ndarray:
+        """The block point: the assignment vector as a host array.
+        Memoized both ways — a second fetch of a completed handle is
+        free, a second fetch of a FAILED handle re-raises the memoized
+        failure as ``SolveFailed`` (never a consumed-future error)."""
+        from ..solver.containment import SolveFailed
+
         if self._assigned is not None:
             return self._assigned
-        if self._future is not None:
-            assigned, _ = self._future.result()
-            self._assigned = np.asarray(assigned)
-            self.rounds = 1
-            from ..native.greedy import last_solve_stats
-
-            self.native_stats = dict(last_solve_stats)
-        else:
-            self._assigned = np.asarray(self._result.assigned)
-            self.rounds = int(self._result.rounds)
-            if self._result.refills is not None:
-                self.refills = int(self._result.refills)
-            if self._result.stages is not None:
-                self.stages = int(self._result.stages)
+        if self._error is not None:
+            raise SolveFailed(
+                f"{self.backend} solve already failed: {self._error!r}"
+            ) from self._error
+        try:
+            if self._future is not None:
+                self._fetch_native(timeout)
+            else:
+                self._fetch_jax(timeout)
+        except BaseException as exc:
+            self._error = exc
+            # Detach: the failed future/device result is dead to us;
+            # anything arriving late is discarded with these refs.
+            self._future = None
+            self._result = None
+            if not isinstance(exc, Exception):
+                # KeyboardInterrupt/SystemExit must terminate, not be
+                # rewrapped into the degradation ladder's Exception
+                # handling (a Ctrl-C at the block point would otherwise
+                # be absorbed as a "device failure" and the loop would
+                # keep running).
+                raise
+            if isinstance(exc, SolveFailed):
+                raise
+            raise SolveFailed(
+                f"{self.backend} solve failed: {exc}"
+            ) from exc
         return self._assigned
+
+    def failed(self) -> bool:
+        return self._error is not None
 
     def drain(self) -> None:
         """Guard-path fetch: block until the solve is out of flight,
         swallowing errors (the caller is tearing down or about to
-        mutate state; a failed solve must not mask that path)."""
+        mutate state; a failed solve must not mask that path). Deadline
+        -bounded like the action's own fetch — a hung solve must not
+        wedge a transaction boundary or session close either."""
+        from ..solver import containment
+
         try:
-            self.fetch()
+            self.fetch(timeout=containment.solve_budget())
         except Exception:  # pragma: no cover - defensive
             logger.exception("in-flight solve drain failed")
 
@@ -220,6 +340,96 @@ class AllocateTpuAction(Action):
 
     def name(self) -> str:
         return "allocate_tpu"
+
+    # -- fault-containment ladder -------------------------------------------
+
+    def _launch_rung(self, rung: str, inputs, ctx) -> AsyncSolveHandle:
+        """One rung's dispatch. ``native`` consumes the host-side
+        :class:`SolverInputs` that every tensorize (device or not)
+        leaves on the context — the floor must never touch a device
+        that just failed, not even to read the fallback bundle."""
+        from ..solver import containment
+
+        if rung == "native":
+            return AsyncSolveHandle.launch(
+                ctx.host_inputs, True, self.max_rounds
+            )
+        return AsyncSolveHandle.launch(
+            inputs, False, self.max_rounds,
+            fault_hook=containment.device_fault_hook(),
+        )
+
+    def _solve_ladder(self, ssn, rungs, inputs, ctx, handle, budget,
+                      ladder):
+        """Fetch with degradation: any failure in a device rung re-solves
+        the SAME cycle on the next rung down (sparse → dense → native);
+        a deadline expiry jumps straight to the native floor (the device
+        is wedged — a dense re-dispatch would just burn another budget)
+        and quarantines the backend via the breaker. Returns
+        ``(assigned, final_handle)``; raises ``SolveFailed`` only when
+        the native floor itself fails (the guarded loop absorbs it).
+
+        ``ladder`` accumulates one record per attempt — the flight
+        record / verdict / bench attribution of which rungs ran."""
+        from ..solver.containment import (
+            BREAKER,
+            SolveFailed,
+            SolveTimeout,
+            note_fallback,
+            strip_candidates,
+        )
+
+        idx = 0
+        cur_inputs = inputs
+        while True:
+            rung = rungs[idx]
+            try:
+                if handle is None:
+                    handle = self._launch_rung(rung, cur_inputs, ctx)
+                    ssn.register_inflight_solve(handle)
+                assigned = handle.fetch(timeout=budget)
+            except Exception as exc:
+                ssn.register_inflight_solve(None)
+                handle = None
+                timed_out = isinstance(exc, SolveTimeout)
+                reason = "timeout" if timed_out else "exception"
+                exc_name = type(exc.__cause__ or exc).__name__
+                ladder.append({
+                    "rung": rung, "outcome": reason, "exc": exc_name,
+                })
+                if rung == "native":
+                    # The floor failed: nothing below it — surface the
+                    # typed failure to the guarded cycle loop.
+                    if isinstance(exc, SolveFailed):
+                        raise
+                    raise SolveFailed(
+                        f"native floor solve failed: {exc}"
+                    ) from exc
+                BREAKER.record_device_failure(
+                    reason, exc=exc_name, open_now=timed_out
+                )
+                nxt = "native" if timed_out else rungs[idx + 1]
+                idx = rungs.index(nxt)
+                metrics.register_solver_fallback(rung, nxt, reason)
+                note_fallback(rung, nxt, reason, exc=exc_name)
+                logger.error(
+                    "solve rung %r failed (%s: %s); re-solving this "
+                    "cycle on %r", rung, reason, exc_name, nxt,
+                )
+                if nxt == "dense":
+                    cur_inputs = strip_candidates(cur_inputs)
+                continue
+            if rung != "native" and not ladder:
+                # Only a CLEAN device cycle resets the failure streak.
+                # A cycle rescued by a lower device rung (sparse failed,
+                # dense solved) still had a device-path failure — if
+                # dense kept resetting the streak, a persistently broken
+                # sparse program would burn a failed dispatch every
+                # cycle forever without ever reaching the breaker
+                # threshold.
+                BREAKER.record_device_success()
+            ladder.append({"rung": rung, "outcome": "ok"})
+            return assigned, handle
 
     @staticmethod
     def _releasing_candidates(ssn, ctx):
@@ -255,9 +465,46 @@ class AllocateTpuAction(Action):
         # together ~180 ms of the 50k delta cycle (r4/r5 profiles) spent
         # shuttling data through JAX for a solve that runs in C++.
         use_native = _use_native_solver()
+        # Circuit-breaker gate (solver/containment.py), also before
+        # tensorize: an OPEN breaker pins the cycle to the native floor
+        # without touching the quarantined device at all — no device
+        # pack, no dispatch, no per-cycle failure latency. allow_device
+        # ticks the cooldown and, at expiry, runs the bounded canary
+        # probe (success re-promotes this very cycle).
+        from ..solver import containment
+
+        breaker_pinned = False
+        if not use_native and not containment.BREAKER.allow_device():
+            use_native = True
+            breaker_pinned = True
+            last_stats["breaker_pinned"] = True
         t0 = time.perf_counter()
         with span("tensorize"):
-            inputs, ctx = tensorize(ssn, device=not use_native)
+            try:
+                inputs, ctx = tensorize(ssn, device=not use_native)
+            except Exception as exc:
+                if use_native:
+                    raise
+                # Device pack failed (dead backend, OOM during the
+                # host→device upload): same containment as a dispatch
+                # failure — quarantine via the breaker and rebuild
+                # host-side for the native floor.
+                exc_name = type(exc).__name__
+                containment.BREAKER.record_device_failure(
+                    "exception", exc=exc_name
+                )
+                metrics.register_solver_fallback(
+                    "device", "native", "tensorize"
+                )
+                containment.note_fallback(
+                    "device", "native", "tensorize", exc=exc_name
+                )
+                logger.error(
+                    "device tensorize failed (%s); re-packing "
+                    "host-side for the native floor", exc_name,
+                )
+                use_native = True
+                inputs, ctx = tensorize(ssn, device=False)
         _record_phase("tensorize", (time.perf_counter() - t0) * 1e3)
         # Incremental-tensorize forensics (dirty-row counts, fallback
         # reasons) for the bench/BENCH attribution.
@@ -277,6 +524,29 @@ class AllocateTpuAction(Action):
             except Exception:  # pragma: no cover - forensics only
                 logger.exception("idle-cycle verdict GC failed")
             return
+        if breaker_pinned:
+            # Counted here, not at the gate: the metric's documented
+            # semantics are ladder descents — a cycle actually re-solved
+            # on a lower rung — and an idle cycle (inputs None above)
+            # solves nothing, so a breaker open across an idle stretch
+            # must not tick one phantom descent per period.
+            metrics.register_solver_fallback(
+                "device", "native", "breaker-open"
+            )
+
+        # Degradation-ladder rungs for this cycle, top first. The top
+        # rung is whatever the backend decision + tensorize produced
+        # (candidate slabs → sparse program); every device cycle keeps
+        # dense and the native CPU floor below it, so a runtime device
+        # fault degrades scheduling quality, never the cycle.
+        if use_native:
+            rungs = ["native"]
+        else:
+            cand = getattr(inputs, "cand_idx", None)
+            sparse_slabs = cand is not None and int(cand.shape[0]) > 0
+            rungs = (["sparse"] if sparse_slabs else []) + [
+                "dense", "native"
+            ]
 
         t0 = time.perf_counter()
         # OVERLAPPED solve: launch is async (device rounds via XLA
@@ -284,9 +554,21 @@ class AllocateTpuAction(Action):
         # the window below runs host work that does not depend on the
         # assignment, and handle.fetch() is the single block point.
         with span("solve_dispatch", jax_annotate=True):
-            handle = AsyncSolveHandle.launch(
-                inputs, use_native, self.max_rounds
-            )
+            try:
+                handle = self._launch_rung(rungs[0], inputs, ctx)
+            except Exception as exc:
+                # Synchronous dispatch failure (trace/compile error,
+                # device lost at launch): enter the ladder handle-less.
+                # Its first iteration re-launches this rung inside the
+                # guarded try, so the failure descends rungs instead of
+                # escaping the cycle — the one uncontained window the
+                # async fetch path would otherwise leave.
+                handle = None
+                logger.error(
+                    "solve dispatch on rung %r raised %s; deferring "
+                    "to the degradation ladder",
+                    rungs[0], type(exc).__name__,
+                )
         ssn.register_inflight_solve(handle)
         t_launch = time.perf_counter()
         last_stats["solve_launch_ms"] = (t_launch - t0) * 1e3
@@ -306,7 +588,7 @@ class AllocateTpuAction(Action):
         # only the snapshot, never the assignment.
         with span("overlap_window"):
             releasing_nodes = self._releasing_candidates(ssn, ctx)
-            if not handle.done():
+            if handle is not None and not handle.done():
                 # The previous cycle's async bind/evict side effects
                 # drain on their worker threads; parking here (bounded)
                 # yields the GIL to them inside the solve's shadow
@@ -323,8 +605,17 @@ class AllocateTpuAction(Action):
         ) * 1e3
 
         t_block = time.perf_counter()
+        # The block point, now deadline-bounded and ladder-guarded: any
+        # device-rung exception re-solves THIS cycle one rung down, a
+        # budget expiry abandons the handle and drops to the native
+        # floor (quarantining the backend via the breaker). Only a
+        # native-floor failure escapes to the guarded cycle loop.
+        ladder: list = []
+        budget = containment.solve_budget()
         with span("solve_block", jax_annotate=True):
-            assigned = handle.fetch()
+            assigned, handle = self._solve_ladder(
+                ssn, rungs, inputs, ctx, handle, budget, ladder
+            )
         ssn.register_inflight_solve(None)
         rounds, backend = handle.rounds, handle.backend
         metrics.update_solver_cycle(rounds, backend)
@@ -333,6 +624,11 @@ class AllocateTpuAction(Action):
         ) * 1e3
         _record_phase("solve", (time.perf_counter() - t0) * 1e3)
         last_stats.update(backend=backend, rounds=rounds)
+        last_stats["solve_ladder"] = ladder
+        if len(ladder) > 1:
+            # Rung descents happened: flag the cycle as degraded so the
+            # bench/flight-record readers need no ladder parsing.
+            last_stats["solve_degraded"] = True
 
         # Sparse-solve attribution: whether this cycle's solve ran the
         # candidate-sparsified path, how much refill work it needed, and
@@ -591,6 +887,13 @@ class AllocateTpuAction(Action):
             "rounds": rounds,
             "placed": placed,
             "tasks": len(ctx.tasks),
+            # Fault-containment attribution: the rung sequence this
+            # cycle actually ran (one entry per attempt), the breaker's
+            # state after it, and the last ladder descent — the flight
+            # record's "why is this cycle degraded" answer.
+            "ladder": list(ladder),
+            "degraded": len(ladder) > 1 or breaker_pinned,
+            "breaker_state": containment.BREAKER.state,
             "sparse_engaged": engaged,
             "sparse_k": tsparse.get("k") if engaged else None,
             "sparse_refill_rounds": refill_rounds if engaged else None,

@@ -16,6 +16,7 @@ the event-handler entry points directly.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -44,6 +45,14 @@ from ..cluster import ADDED, DELETED, MODIFIED, ClusterAPI
 from .event_handlers import EventHandlersMixin
 from .interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
 from .util import job_terminated, shadow_pod_group
+
+
+class CacheFencedError(RuntimeError):
+    """A bind/evict was refused because the cache is fenced: the loop
+    watchdog (or the leader-election layer) declared this process a
+    deposed leader, and a deposed leader must not mutate the cluster —
+    a successor holding the lease may already be scheduling the same
+    tasks (doc/design/robustness.md)."""
 
 
 class DefaultBinder(Binder):
@@ -176,6 +185,14 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self.deleted_jobs: "queue.Queue[tuple]" = queue.Queue()
         self._base_retry_delay = 0.05
         self._max_retry_delay = 5.0
+        # Poisoned-task cap: a task whose reconcile fails this many
+        # times is dropped terminally (counted + named in the job's
+        # unschedulable verdict) instead of circulating in the resync
+        # queue forever — the reference rate-limits but never gives up,
+        # which turns one poisoned task into permanent queue churn.
+        self._max_resync_attempts = int(
+            os.environ.get("KBT_RESYNC_MAX_ATTEMPTS", "8")
+        )
         self._dispatch = self._build_dispatch()
 
         # COW snapshot pool: {key: (src_ver, clone, clone_ver)} per kind
@@ -203,6 +220,65 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self._inflight_cond = threading.Condition()
         self._synced = cluster is None
         self._stop = threading.Event()
+        # Leadership fence (None = unfenced). Set by the loop watchdog /
+        # leader-election layer; checked at every bind/evict dispatch
+        # point, including the async side-effect halves — a side-effect
+        # thread queued by a leader that has since been deposed must not
+        # issue its bind against the cluster. Guarded by its OWN lock,
+        # never self.mutex: the watchdog fences precisely when a wedged
+        # cycle may be deadlocked HOLDING the mutex, and the fencing
+        # path must not join that deadlock.
+        self._fence_reason: Optional[str] = None
+        self._fence_lock = threading.Lock()
+        self._fence_refusals = 0
+
+    # -- leadership fencing ---------------------------------------------------
+
+    def fence(self, reason: str) -> None:
+        """Refuse all future bind/evict side effects (idempotent; first
+        reason wins — it names the original deposition cause)."""
+        with self._fence_lock:
+            if self._fence_reason is None:
+                self._fence_reason = reason or "fenced"
+        logger.error(
+            "scheduler cache FENCED (%s): all bind/evict side effects "
+            "will be refused", self._fence_reason,
+        )
+
+    def unfence(self) -> None:
+        """Lift the fence (tests; a re-elected process restarts its
+        cache instead — fencing is meant to be terminal)."""
+        with self._fence_lock:
+            self._fence_reason = None
+            self._fence_refusals = 0
+
+    def fence_reason(self) -> Optional[str]:
+        return self._fence_reason
+
+    def _refused_by_fence(self, what: str) -> bool:
+        """One dispatch-point fence check; counts the refusal. Every
+        refusal bumps the metric, but the log line is damped: fencing
+        a leader with a deep bind backlog refuses one call per queued
+        pod, and tens of thousands of identical warnings would bury
+        the one FENCED line that names the deposition cause."""
+        reason = self._fence_reason
+        if reason is None:
+            return False
+        try:
+            from .. import metrics
+
+            metrics.register_bind_fenced()
+        except Exception:  # pragma: no cover - metrics must never kill
+            logger.exception("fence metric update failed")
+        with self._fence_lock:
+            self._fence_refusals += 1
+            n = self._fence_refusals
+        if n <= 3 or n % 1000 == 0:
+            logger.warning(
+                "fenced cache (%s) refused %s (%d refusals so far)",
+                reason, what, n,
+            )
+        return True
 
     def _submit_side_effect(self, fn, bookkeeping: bool = False) -> None:
         """Run a bind/evict side effect on the async pool, tracking it so
@@ -355,8 +431,41 @@ class SchedulerCache(Cache, EventHandlersMixin):
         return min(self._base_retry_delay * (2**attempt), self._max_retry_delay)
 
     def _resync_task(self, task: TaskInfo, attempt: int = 0) -> None:
-        """reference cache.go:588-595 (AddRateLimited analog)"""
+        """reference cache.go:588-595 (AddRateLimited analog) — with a
+        terminal cap: past ``KBT_RESYNC_MAX_ATTEMPTS`` the task is
+        dropped (``task_resync_terminal_total``) and named in its job's
+        unschedulable verdict so ``explain``/`/debug/jobs` answer "where
+        did that pod go"."""
+        if attempt >= self._max_resync_attempts:
+            self._drop_poisoned_task(task, attempt)
+            return
         self.err_tasks.put((task, attempt))
+
+    def _drop_poisoned_task(self, task: TaskInfo, attempt: int) -> None:
+        logger.error(
+            "task %s/%s dropped from resync after %d failed reconcile "
+            "attempts (poisoned; will not be retried — external pod "
+            "events re-admit it)",
+            task.namespace, task.name, attempt,
+        )
+        try:
+            from .. import metrics
+
+            metrics.register_resync_terminal()
+        except Exception:  # pragma: no cover - metrics must never kill
+            logger.exception("resync-terminal metric update failed")
+        try:
+            from ..obs import explain
+
+            with self.mutex:
+                job = self.jobs.get(task.job)
+                job_name = job.name if job is not None else task.name
+            explain.note_resync_terminal(
+                task.job, task.namespace, job_name,
+                f"{task.namespace}/{task.name}", attempt,
+            )
+        except Exception:  # pragma: no cover - forensics only
+            logger.exception("resync-terminal verdict note failed")
 
     def _queue_job_cleanup(self, job: JobInfo, attempt: int = 0) -> None:
         self.deleted_jobs.put((job, attempt))
@@ -601,6 +710,11 @@ class SchedulerCache(Cache, EventHandlersMixin):
         the scheduling loop — one slow volume must not stall every other
         job's cycle. A timeout/failure releases the claim assumptions and
         resyncs the task without binding the pod."""
+        if self._refused_by_fence(
+            f"bind side effect {pod.namespace}/{pod.name} -> {hostname}"
+        ):
+            # No resync either: the task is the NEW leader's to place.
+            return
         try:
             self.volume_binder.bind_volumes(task_snapshot)
             self.binder.bind(pod, hostname)
@@ -621,6 +735,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
 
     def bind(self, task_info: TaskInfo, hostname: str) -> None:
         """reference cache.go:480-522"""
+        if self._refused_by_fence(f"bind {task_info.uid} -> {hostname}"):
+            raise CacheFencedError(
+                f"bind of {task_info.uid} refused: {self._fence_reason}"
+            )
         with self.mutex:
             _, task, _ = self._bind_bookkeeping(task_info, hostname)
             pod, task_snapshot = task.pod, task.clone()
@@ -651,6 +769,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
         the same self-correction contract as the reference's
         assume-then-resync bind (cache.go:480-522)."""
         infos = list(task_infos)
+        if infos and self._refused_by_fence(
+            f"bind_batch of {len(infos)} tasks"
+        ):
+            return []
         if not infos:
             if on_accepted is not None:
                 try:
@@ -823,6 +945,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         """reference cache.go:421-477"""
+        if self._refused_by_fence(f"evict {task_info.uid}"):
+            raise CacheFencedError(
+                f"evict of {task_info.uid} refused: {self._fence_reason}"
+            )
         with self.mutex:
             job, task = self._find_job_and_task(task_info)
             node = self.nodes.get(task.node_name)
@@ -842,6 +968,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 )
 
         def _do_evict():
+            if self._refused_by_fence(
+                f"evict side effect {pod.namespace}/{pod.name}"
+            ):
+                return
             try:
                 self.evictor.evict(pod)
             except Exception:

@@ -98,6 +98,31 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             )
         except Exception:  # pragma: no cover - probes must not 500
             logger.exception("/debug/vars watermark probe failed")
+        # Degraded-mode surface (doc/design/robustness.md): breaker
+        # state machine + quarantine age, the last ladder descent, the
+        # loop watchdog, and the leadership fence — one curl says
+        # whether (and why) the scheduler is running on a lower rung.
+        try:
+            from ..scheduler import ACTIVE_WATCHDOG
+            from ..solver import containment
+
+            cache = TELEMETRY.attached_cache()
+            fence_fn = getattr(cache, "fence_reason", None)
+            out["robustness"] = {
+                "breaker": containment.BREAKER.state_dict(),
+                "last_fallback": (
+                    dict(containment.last_fallback) or None
+                ),
+                "solve_budget_seconds": containment.solve_budget(),
+                "watchdog": (
+                    ACTIVE_WATCHDOG.state_dict()
+                    if ACTIVE_WATCHDOG is not None else None
+                ),
+                "watchdog_trips": metrics.scheduler_watchdog_trips.get(),
+                "cache_fence": fence_fn() if fence_fn else None,
+            }
+        except Exception:  # pragma: no cover - probes must not 500
+            logger.exception("/debug/vars robustness probe failed")
         return out
 
     def do_GET(self):  # noqa: N802 (http.server API)
@@ -201,6 +226,26 @@ class LeaderElector:
         self._renew_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.is_leader = False
+        self._lost: Optional[threading.Event] = None
+        self.fenced_reason: Optional[str] = None
+
+    def fence(self, reason: str = "") -> None:
+        """Zombie-leader fencing (called by the loop watchdog via
+        ``Scheduler.fence_hooks``): this process believes it is wedged,
+        so it must STOP renewing and release the lease — otherwise the
+        renew thread, which is perfectly healthy, keeps the cluster
+        hostage to a leader that makes no progress. Signals the lost
+        event too, so anything chained on leadership loss (the
+        scheduling loop's stop) fires when the process unwedges."""
+        self.fenced_reason = reason or "fenced"
+        logger.error(
+            "leader election FENCED (%s): releasing lease, no further "
+            "renewals", self.fenced_reason,
+        )
+        self.is_leader = False
+        if self._lost is not None:
+            self._lost.set()
+        self.release()
 
     def _read_lease(self):
         try:
@@ -226,6 +271,11 @@ class LeaderElector:
         file instead."""
         import fcntl
 
+        if self._stop.is_set():
+            # release()/fence() is clearing the lease: an in-flight
+            # renew must not re-acquire it for the dying identity.
+            self.is_leader = False
+            return False
         with open(f"{self.lock_path}.mutex", "a+") as mutex:
             try:
                 # Non-blocking: a peer frozen INSIDE the critical section
@@ -261,6 +311,7 @@ class LeaderElector:
             return
 
         lost = threading.Event()
+        self._lost = lost
 
         def renew_loop():
             last_renew = time.time()
@@ -285,6 +336,16 @@ class LeaderElector:
 
     def release(self) -> None:
         self._stop.set()
+        # Drain the renew loop BEFORE removing the lease file: a renew
+        # whose read-check-write straddles the removal would re-create
+        # the lease for a dying identity, pinning the cluster to it for
+        # a full lease_duration (the same zombie-renew race the Kube
+        # elector drains; fence() relies on this ordering too).
+        if (
+            self._renew_thread is not None
+            and self._renew_thread is not threading.current_thread()
+        ):
+            self._renew_thread.join(timeout=10.0)
         lease = self._read_lease()
         if lease and lease["holder"] == self.identity:
             try:
@@ -321,6 +382,8 @@ class KubeLeaseElector(LeaderElector):
         self._renew_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.is_leader = False
+        self._lost: Optional[threading.Event] = None
+        self.fenced_reason: Optional[str] = None
         # True once this identity has EVER held the lease. release()
         # keys on this, not on the last attempt's is_leader: a transient
         # API failure (or lost CAS) right before shutdown flips
@@ -453,6 +516,11 @@ def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
             elector = LeaderElector(
                 opt.lock_object_namespace, identity=identity
             )
+        # Zombie-leader fencing: a loop-watchdog trip (cycle hung past
+        # its no-progress budget) stops lease renewal and releases it,
+        # so a healthy instance can take over while the cache fence
+        # keeps this process's side-effect threads from issuing binds.
+        sched.fence_hooks.append(elector.fence)
         try:
             elector.run(
                 on_started_leading=run_scheduler,

@@ -375,6 +375,58 @@ scheduler_cycle_errors = REGISTRY.register(
         "retried with capped exponential backoff)",
     )
 )
+# Solver fault containment (kube_batch_tpu/solver/containment.py +
+# actions/allocate_tpu.py ladder): every time a cycle's solve descends
+# a rung (sparse -> dense -> native), why, plus the circuit breaker's
+# state machine and the loop watchdog.
+solver_fallback = REGISTRY.register(
+    Counter(
+        "solver_fallback_total",
+        "Solve-ladder descents by rung pair and reason "
+        "(exception/timeout/breaker-open/tensorize) — the "
+        "fault-containment layer re-solving a cycle on a lower rung "
+        "instead of failing it",
+    ),
+    ("from", "to", "reason"),
+)
+solver_breaker_state = REGISTRY.register(
+    Gauge(
+        "solver_breaker_state",
+        "Device-path circuit breaker state (0=closed, 1=half-open, "
+        "2=open); open pins cycles to the native floor until the "
+        "canary probe re-promotes",
+    )
+)
+solver_breaker_transitions = REGISTRY.register(
+    Counter(
+        "solver_breaker_transitions_total",
+        "Circuit breaker state transitions by target state",
+    ),
+    ("to",),
+)
+scheduler_watchdog_trips = REGISTRY.register(
+    Counter(
+        "scheduler_watchdog_trips_total",
+        "Loop-watchdog detections of a cycle exceeding its no-progress "
+        "budget (flight recorder dumped, leadership fenced)",
+    )
+)
+task_resync_terminal = REGISTRY.register(
+    Counter(
+        "task_resync_terminal_total",
+        "Poisoned tasks dropped from the resync queue after exhausting "
+        "the max reconcile attempts (named in the job's unschedulable "
+        "verdict detail)",
+    )
+)
+cache_binds_fenced = REGISTRY.register(
+    Counter(
+        "cache_binds_fenced_total",
+        "Bind/evict side effects refused by the leadership fencing "
+        "check (a deposed or watchdog-fenced leader must not mutate "
+        "the cluster)",
+    )
+)
 sim_cycles = REGISTRY.register(
     Counter("sim_cycles_total", "Simulated scheduling cycles driven")
 )
@@ -588,6 +640,33 @@ def update_solver_jit_cache(count: int) -> None:
 def register_cycle_error() -> None:
     """One scheduling cycle raised and was absorbed by the guarded loop."""
     scheduler_cycle_errors.inc()
+
+
+def register_solver_fallback(frm: str, to: str, reason: str) -> None:
+    """One solve-ladder descent: the ``frm`` rung failed (``reason`` in
+    exception/timeout/breaker-open) and the cycle re-solved on ``to``."""
+    solver_fallback.inc((frm, to, reason))
+
+
+_BREAKER_STATE_VALUES = {"closed": 0.0, "half-open": 1.0, "open": 2.0}
+
+
+def update_breaker_state(state: str, transition: bool = True) -> None:
+    solver_breaker_state.set(_BREAKER_STATE_VALUES.get(state, -1.0))
+    if transition:
+        solver_breaker_transitions.inc((state,))
+
+
+def register_watchdog_trip() -> None:
+    scheduler_watchdog_trips.inc()
+
+
+def register_resync_terminal() -> None:
+    task_resync_terminal.inc()
+
+
+def register_bind_fenced() -> None:
+    cache_binds_fenced.inc()
 
 
 def update_unschedulable_reasons(counts: dict) -> None:

@@ -45,9 +45,11 @@ REASON_NO_FIT = "no-fit"
 # Every reason the verdict classifier can emit, in precedence order;
 # the metric helper zeroes absent reasons from exactly this list so
 # stale gauge labels never linger.
+REASON_RESYNC = "resync-terminal"
+
 ALL_REASONS = (
     REASON_PREDICATE, REASON_QUEUE, REASON_REFILL, REASON_GANG,
-    REASON_NO_FIT,
+    REASON_NO_FIT, REASON_RESYNC,
 )
 
 
@@ -88,6 +90,11 @@ VERDICTS: Dict[str, JobVerdict] = {}
 # job uid -> latest preempt/reclaim victim-selection outcome, folded
 # into the job's next verdict detail (actions note these as they run).
 _VICTIM_NOTES: Dict[str, dict] = {}
+# job uid -> {task key: {attempts, ts}} for tasks the cache dropped
+# terminally from the resync queue (cache._drop_poisoned_task). Sticky
+# (unlike victim notes): the drop is permanent, so every later verdict
+# for the job keeps naming the task until the job leaves the registry.
+_RESYNC_NOTES: Dict[str, Dict[str, dict]] = {}
 
 
 def get_verdict(uid: str) -> Optional[JobVerdict]:
@@ -104,6 +111,43 @@ def clear() -> None:
     with _lock:
         VERDICTS.clear()
         _VICTIM_NOTES.clear()
+        _RESYNC_NOTES.clear()
+
+
+def note_resync_terminal(
+    job_uid: str, namespace: str, job_name: str, task_key: str,
+    attempts: int,
+) -> None:
+    """The cache dropped ``task_key`` from the resync queue terminally
+    (poisoned: ``attempts`` consecutive reconcile failures). Record it
+    immediately — a standalone ``resync-terminal`` verdict when the job
+    has none yet, a detail note otherwise — so ``explain <job>`` and
+    ``/debug/jobs`` name the task without waiting for the next solve
+    cycle to classify the job."""
+    now = time.time()
+    note = {"attempts": int(attempts), "ts": now}
+    with _lock:
+        _RESYNC_NOTES.setdefault(job_uid, {})[task_key] = note
+        tasks = dict(_RESYNC_NOTES[job_uid])
+        v = VERDICTS.get(job_uid)
+        if v is None:
+            v = JobVerdict(
+                uid=job_uid, namespace=namespace, name=job_name,
+                queue="", reason=REASON_RESYNC,
+                message=(
+                    f"task {task_key} dropped from resync after "
+                    f"{attempts} failed reconcile attempts"
+                ),
+                unassigned=0, ts=now,
+            )
+            VERDICTS[job_uid] = v
+        v.detail["resync_terminal"] = tasks
+        if v.reason == REASON_RESYNC:
+            # The standalone verdict's unassigned count is the number
+            # of terminally-dropped tasks, so the reason-labeled gauge
+            # (which sums verdict.unassigned per reason on idle-cycle
+            # re-derivation) actually reports the drops.
+            v.unassigned = len(tasks)
 
 
 def note_victim_outcome(
@@ -189,6 +233,7 @@ def record_cycle_verdicts(ssn, ctx, assigned, sparse=None) -> Dict[str, int]:
     with _lock:
         notes = dict(_VICTIM_NOTES)
         _VICTIM_NOTES.clear()
+        resync_notes = {k: dict(v) for k, v in _RESYNC_NOTES.items()}
     new_verdicts: Dict[str, JobVerdict] = {}
     for uid, (rep, count) in per_job.items():
         job = ssn.jobs.get(uid)
@@ -219,6 +264,11 @@ def record_cycle_verdicts(ssn, ctx, assigned, sparse=None) -> Dict[str, int]:
         note = notes.get(uid)
         if note is not None:
             detail["victim_selection"] = note
+        dropped = resync_notes.get(uid)
+        if dropped:
+            # Sticky: terminally-dropped tasks keep being named until
+            # the job leaves the registry.
+            detail["resync_terminal"] = dropped
         message = (
             f"{count} task(s) unassigned: {qualifier}; representative "
             f"task has {feasible} feasible node(s)"
@@ -249,6 +299,18 @@ def record_cycle_verdicts(ssn, ctx, assigned, sparse=None) -> Dict[str, int]:
                 or not job.task_status_index.get(TaskStatus.PENDING)
             ):
                 VERDICTS.pop(uid, None)
+                _RESYNC_NOTES.pop(uid, None)
+            elif VERDICTS[uid].reason == REASON_RESYNC:
+                # Surviving standalone resync-terminal verdicts describe
+                # tasks the cache dropped — they are never in ctx.tasks,
+                # so the per-cycle classification above cannot count
+                # them. Fold them in here, or the absent-reason zeroing
+                # in update_unschedulable_reasons erases the gauge
+                # bucket on every busy cycle.
+                reason_counts[REASON_RESYNC] = (
+                    reason_counts.get(REASON_RESYNC, 0)
+                    + VERDICTS[uid].unassigned
+                )
 
     metrics.update_unschedulable_reasons(reason_counts)
     return reason_counts
@@ -273,6 +335,7 @@ def record_idle_cycle(ssn) -> None:
                 or not job.task_status_index.get(TaskStatus.PENDING)
             ):
                 VERDICTS.pop(uid, None)
+                _RESYNC_NOTES.pop(uid, None)
             else:
                 v = VERDICTS[uid]
                 counts[v.reason] = counts.get(v.reason, 0) + v.unassigned

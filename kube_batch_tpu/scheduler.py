@@ -9,9 +9,10 @@ and pkg/scheduler/util.go (:44 loadSchedulerConf, :32 defaultSchedulerConf).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from . import metrics
 from .conf import DEFAULT_SCHEDULER_CONF, Tier, parse_scheduler_conf
@@ -21,6 +22,126 @@ from .obs.tracer import TRACER, maybe_enable_from_env
 from .utils import deferred_gc
 
 logger = logging.getLogger(__name__)
+
+# The running loop's watchdog (set by Scheduler.run when it starts
+# one): the /debug/vars handler has no Scheduler reference, so the
+# degraded-mode surface reads the live state from here.
+ACTIVE_WATCHDOG: Optional["LoopWatchdog"] = None
+
+
+class LoopWatchdog:
+    """No-cycle-progress detector: the last line of the solver
+    fault-containment layer (doc/design/robustness.md).
+
+    The in-cycle deadlines (``AsyncSolveHandle.fetch(timeout=...)``)
+    bound the SOLVE; this thread bounds the whole cycle, catching hangs
+    the fetch deadline cannot see — a wedged plugin, a deadlocked
+    session close, a foreign call outside the solve. The scheduler
+    stamps ``cycle_begin``/``cycle_end`` around ``run_once``; when a
+    cycle stays in flight past ``budget`` seconds the watchdog trips
+    ONCE for that cycle: flight recorder dumped (KBT_FLIGHT_DIR),
+    ``scheduler_watchdog_trips_total`` bumped, and the ``on_trip``
+    fencing callback fired — which tells the leader-election layer to
+    stop renewing and release the lease, and fences the cache so the
+    side-effect threads of this now-deposed leader can issue no binds.
+    The wedged process is left to the operator (it may be unkillable
+    from inside); what matters is the CLUSTER moves on to a new leader
+    that is not hostage to this one's lease."""
+
+    def __init__(
+        self,
+        budget: float,
+        on_trip: Optional[Callable[[str], None]] = None,
+        interval: Optional[float] = None,
+    ):
+        self.budget = float(budget)
+        self.interval = interval or max(0.2, min(5.0, self.budget / 4.0))
+        self.on_trip = on_trip
+        self.trips = 0
+        self.last_trip: Optional[dict] = None
+        self._lock = threading.Lock()
+        self._inflight_since: Optional[float] = None
+        self._inflight_cycle: Optional[int] = None
+        self._tripped_cycle: Optional[int] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+
+    def cycle_begin(self, cycle: int) -> None:
+        with self._lock:
+            self._inflight_since = time.monotonic()
+            self._inflight_cycle = cycle
+
+    def cycle_end(self) -> None:
+        with self._lock:
+            self._inflight_since = None
+            self._inflight_cycle = None
+
+    def start(self, stop_event: threading.Event) -> None:
+        self._stop = stop_event
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="kbt-loop-watchdog"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.check()
+            except Exception:  # pragma: no cover - watchdog must survive
+                logger.exception("loop watchdog check failed")
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """One poll; returns True iff it tripped. Public so tests (and
+        embedders without the thread) can drive it synchronously."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            since, cycle = self._inflight_since, self._inflight_cycle
+            if (
+                since is None
+                or now - since <= self.budget
+                or cycle == self._tripped_cycle
+            ):
+                return False
+            self._tripped_cycle = cycle  # once per wedged cycle
+            age = now - since
+            self.trips += 1
+            self.last_trip = {
+                "cycle": cycle, "age_seconds": round(age, 3),
+                "budget_seconds": self.budget, "ts": time.time(),
+            }
+        logger.error(
+            "loop watchdog TRIPPED: cycle %s in flight %.1fs (budget "
+            "%.1fs) — dumping flight recorder and fencing leadership",
+            cycle, age, self.budget,
+        )
+        metrics.register_watchdog_trip()
+        try:
+            RECORDER.dump_on_error()
+        except Exception:  # pragma: no cover - forensics only
+            logger.exception("watchdog flight dump failed")
+        if self.on_trip is not None:
+            try:
+                self.on_trip(
+                    f"watchdog: cycle {cycle} exceeded "
+                    f"{self.budget:.1f}s no-progress budget"
+                )
+            except Exception:  # pragma: no cover - fencing is best-effort
+                logger.exception("watchdog on_trip fencing hook failed")
+        return True
+
+    def state_dict(self) -> dict:
+        """/debug/vars snapshot."""
+        with self._lock:
+            inflight = (
+                round(time.monotonic() - self._inflight_since, 3)
+                if self._inflight_since is not None else None
+            )
+            return {
+                "budget_seconds": self.budget,
+                "trips": self.trips,
+                "last_trip": dict(self.last_trip) if self.last_trip else None,
+                "cycle_inflight_seconds": inflight,
+            }
 
 
 def load_scheduler_conf(confstr: str) -> Tuple[List[Action], List[Tier]]:
@@ -84,6 +205,45 @@ class Scheduler:
         self.clock = clock or _WallClock()
         self._error_streak = 0
         self._cycle_count = 0
+        # Solver fault containment: stamp the process-wide solve budget
+        # from this scheduler's period (solver/containment.py; the
+        # simulator overrides it after construction with a small
+        # real-time budget). The loop watchdog's no-progress budget sits
+        # ABOVE the fetch deadline — the fetch recovering a hung solve
+        # must never race the watchdog fencing the leader for it.
+        from .solver import containment
+
+        containment.configure_from_period(schedule_period)
+        # solve_budget() (not the stamped value): the fetch deadline
+        # honors a KBT_SOLVE_BUDGET override, and the watchdog budget
+        # must track the deadline it sits above — otherwise a raised
+        # solve budget lets the watchdog fence a healthy leader
+        # mid-solve. 4x, not 2x: the degradation ladder's worst case is
+        # THREE sequential budget-bounded rung attempts in one cycle
+        # (sparse fails just under the budget, dense likewise, native
+        # floor solves) — a cycle actively recovering down the ladder
+        # must never be fenced as wedged.
+        solve_budget = containment.solve_budget()
+        default_budget = 4.0 * solve_budget + 10.0 * schedule_period
+        env_budget = os.environ.get("KBT_WATCHDOG_BUDGET")
+        self.watchdog_budget = default_budget
+        if env_budget:
+            try:
+                parsed = float(env_budget)
+            except ValueError:
+                logger.warning(
+                    "unparseable KBT_WATCHDOG_BUDGET=%r ignored "
+                    "(using %.1fs)", env_budget, default_budget,
+                )
+            else:
+                # <= 0 disables the watchdog (same as KBT_WATCHDOG=0):
+                # a 0-second budget would fence a healthy leader on the
+                # first poll of any in-flight cycle.
+                self.watchdog_budget = parsed
+        # Fencing callbacks beyond the cache (cli/server.py appends the
+        # leader elector's fence); fired from the watchdog thread.
+        self.fence_hooks: List[Callable[[str], None]] = []
+        self.watchdog: Optional[LoopWatchdog] = None
         # KBT_TRACE_DIR arms the span tracer for the whole loop; the
         # trace file is written on loop exit and on cycle errors.
         maybe_enable_from_env()
@@ -105,7 +265,13 @@ class Scheduler:
         simulator's cycle driver, so a sim fault run exercises exactly
         the production error path."""
         try:
-            self.run_once()
+            try:
+                self.run_once()
+            finally:
+                # An errored cycle still ENDED — the watchdog only
+                # fences cycles that never come back.
+                if self.watchdog is not None:
+                    self.watchdog.cycle_end()
         except Exception as exc:
             self._error_streak += 1
             metrics.register_cycle_error()
@@ -142,6 +308,18 @@ class Scheduler:
         # Live-process forensics: SIGUSR1 dumps the flight-recorder ring
         # (no-op on non-main threads — the sim drives cycles directly).
         install_sigusr1()
+        # Loop watchdog (KBT_WATCHDOG=0 disables): only the free-running
+        # production loop gets one — run_once embedders and the
+        # simulator bound their cycles themselves.
+        if (os.environ.get("KBT_WATCHDOG", "1") != "0"
+                and self.watchdog_budget > 0):
+            self._run_stop = stop
+            self.watchdog = LoopWatchdog(
+                self.watchdog_budget, on_trip=self._on_watchdog_trip
+            )
+            self.watchdog.start(stop)
+            global ACTIVE_WATCHDOG
+            ACTIVE_WATCHDOG = self.watchdog
         self.cache.run(stop)
         self.cache.wait_for_cache_sync(stop)
         while not stop.is_set():
@@ -176,6 +354,37 @@ class Scheduler:
         # buffered spans so an operator-stopped run leaves a trace.
         export_trace(tag="trace")
 
+    def _on_watchdog_trip(self, reason: str) -> None:
+        """Fencing half of a watchdog trip: this (possibly wedged)
+        process must lose the power to mutate the cluster BEFORE a
+        successor takes the lease — cache side-effect threads refuse
+        binds/evicts from here on, and every registered fence hook
+        (the leader elector: stop renewing, release) fires."""
+        # Cache fence FIRST: it is non-blocking by construction (its
+        # own lock, never cache.mutex — the wedged cycle may hold the
+        # mutex), while the elector's fence can block draining its
+        # renew thread. Releasing the lease before the fence lands
+        # would let this leader's queued side-effect threads keep
+        # binding while a successor starts placing the same tasks —
+        # the process must lose bind power BEFORE anyone else can
+        # take the lease.
+        fence = getattr(self.cache, "fence", None)
+        if fence is not None:
+            fence(reason)
+        for hook in self.fence_hooks:
+            try:
+                hook(reason)
+            except Exception:  # pragma: no cover - fencing best-effort
+                logger.exception("fence hook failed")
+        # A fenced scheduler can never bind again — stop the run loop
+        # so the process exits (and a supervisor restarts it) instead
+        # of spinning CacheFencedError cycles forever. With an elector
+        # the lost-leadership path stops it anyway; standalone (no
+        # fence hooks) this is the only exit.
+        run_stop = getattr(self, "_run_stop", None)
+        if run_stop is not None:
+            run_stop.set()
+
     def run_once(self) -> None:
         """One scheduling cycle (reference scheduler.go:88-103). GC is
         deferred for the cycle's duration — collections triggered by the
@@ -191,6 +400,8 @@ class Scheduler:
         self._cycle_count += 1
         TRACER.begin_cycle(cycle)
         RECORDER.begin_cycle(cycle)
+        if self.watchdog is not None:
+            self.watchdog.cycle_begin(cycle)
         cycle_start = time.perf_counter()
         with span("cycle"):
             with deferred_gc():

@@ -26,7 +26,8 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--faults", default="",
         help="fault spec, e.g. 'bind:0.05,node-flap:0.02' (kinds: bind, "
-             "node-flap, node-death, evict, solver, crash)")
+             "node-flap, node-death, evict, solver, crash, solver-exc, "
+             "solver-hang, backend-loss)")
     parser.add_argument("--nodes", type=int, default=12)
     parser.add_argument("--node-cpu-m", type=int, default=8000)
     parser.add_argument("--node-mem-mi", type=int, default=16384)

@@ -11,6 +11,16 @@ Spec grammar (doc/design/simulator.md): comma-separated
 | ``evict``      | pre-cycle      | one seeded Running pod deleted (external eviction race); recreated Pending |
 | ``solver``     | per-cycle env  | forces ``KBT_SOLVER=native`` for the cycle (accelerator-backend failure → native fallback) |
 | ``crash``      | action shim    | a raising action is prepended for the cycle, exercising the scheduler's guarded-cycle error backoff |
+| ``solver-exc`` | device-fault hook | the device-solve materialization raises for the cycle; the containment ladder must re-solve on a lower rung |
+| ``solver-hang``| device-fault hook | the device-solve materialization outsleeps the solve budget; the fetch deadline must abandon it and drop to native |
+| ``backend-loss``| device-fault hook | device solves AND the breaker's canary probe raise for a seeded 1-4 cycles (device lost); the breaker must hold open until the window closes, then re-promote |
+
+The device-fault kinds are armed through
+``solver.containment.set_device_fault_hook`` — the hook fires inside
+the fetch-side materialization and the canary probe, exactly where a
+real accelerator fault lands. All three are planned per cycle from the
+seeded stream (the hang/raise DECISION is planned; only its wall-time
+cost is real), so chaos runs replay bit-identically.
 
 Two determinism regimes:
 - cycle-planned faults (flap/death/evict/solver/crash) are drawn from a
@@ -28,13 +38,22 @@ from __future__ import annotations
 import hashlib
 import random
 import threading
-from typing import Dict, List, Sequence, Set, Tuple
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-FAULT_KINDS = ("bind", "node-flap", "node-death", "evict", "solver", "crash")
+FAULT_KINDS = (
+    "bind", "node-flap", "node-death", "evict", "solver", "crash",
+    "solver-exc", "solver-hang", "backend-loss",
+)
 
 
 class SimBindFailure(RuntimeError):
     """Injected bind failure (distinguishable from real bind errors)."""
+
+
+class SimSolverFault(RuntimeError):
+    """Injected device-solve failure (solver-exc / backend-loss; raised
+    from the containment layer's device fault hook)."""
 
 
 def parse_fault_spec(spec: str) -> Dict[str, float]:
@@ -113,6 +132,11 @@ class FaultInjector:
         self._doomed: Set[str] = set()
         self._cluster = None
         self._killed_mid_cycle: Set[str] = set()
+        # Device-fault state (solver-exc / solver-hang / backend-loss):
+        # the per-cycle armed fault and the backend-loss window's end
+        # cycle (exclusive). Consulted by the containment-layer hook.
+        self._solver_fault: Optional[str] = None
+        self._backend_loss_until = -1
         # Forensics drained by the harness each cycle. _bind_faults
         # counts the hash-decided failures only (doomed-node rejections
         # ride under their planned node-death event).
@@ -163,16 +187,72 @@ class FaultInjector:
             events.append({"kind": "solver"})
         if spec.get("crash", 0.0) and rng.random() < spec["crash"]:
             events.append({"kind": "crash"})
+        if (
+            spec.get("solver-exc", 0.0)
+            and rng.random() < spec["solver-exc"]
+        ):
+            events.append({"kind": "solver-exc"})
+        if (
+            spec.get("solver-hang", 0.0)
+            and rng.random() < spec["solver-hang"]
+        ):
+            events.append({"kind": "solver-hang"})
+        p_loss = spec.get("backend-loss", 0.0)
+        if p_loss and rng.random() < p_loss:
+            events.append({
+                "kind": "backend-loss", "down_for": rng.randint(1, 4),
+            })
         return events
 
     # -- cycle arming --------------------------------------------------------
 
-    def begin_cycle(self, cycle: int, doomed_nodes: Sequence[str] = ()) -> None:
+    def begin_cycle(self, cycle: int, doomed_nodes: Sequence[str] = (),
+                    solver_fault: Optional[str] = None) -> None:
         with self._lock:
             self._cycle = cycle
             self._active = True
             self._doomed = set(doomed_nodes)
             self._killed_mid_cycle = set()
+            self._solver_fault = solver_fault  # "exc" | "hang" | None
+
+    def note_backend_loss(self, cycle: int, down_for: int) -> None:
+        """Open (or extend) a backend-loss window: device solves AND
+        the breaker's canary probe fail until ``cycle + down_for``."""
+        with self._lock:
+            self._backend_loss_until = max(
+                self._backend_loss_until, cycle + int(down_for)
+            )
+
+    def device_fault_hook(self):
+        """The callable the harness installs via
+        ``solver.containment.set_device_fault_hook``. Runs inside the
+        device-solve materialization (``stage="solve"``) and the
+        breaker canary (``stage="probe"``); raising fails the stage,
+        outsleeping the budget simulates a hung XLA sync. Decisions are
+        pure functions of the planned per-cycle state — thread-safe and
+        replay-deterministic like the bind hash seam."""
+
+        def hook(stage: str) -> None:
+            with self._lock:
+                if not self._active:
+                    return
+                loss = self._cycle < self._backend_loss_until
+                fault = self._solver_fault
+            if loss:
+                raise SimSolverFault(
+                    f"injected backend loss ({stage} stage)"
+                )
+            if stage != "solve" or fault is None:
+                return
+            if fault == "exc":
+                raise SimSolverFault("injected device-solve exception")
+            # "hang": outsleep the fetch deadline; the abandoned
+            # deadline thread wakes later and its result is discarded.
+            from ..solver.containment import solve_budget
+
+            time.sleep(min(3.0 * solve_budget(), 5.0))
+
+        return hook
 
     def prune_bind_attempts(self, live_uids) -> int:
         """Drop per-pod bind-attempt counters for pods that no longer

@@ -118,6 +118,10 @@ class SimReport:
     # Soak-mode verdict (sim/soak.py): detector results, tripped series,
     # the telemetry dump path, and replay-bisect hints.
     soak: Optional[dict] = None
+    # End-of-run circuit-breaker snapshot (solver/containment.py): a
+    # chaos run asserts re-promotion (state == closed once the injected
+    # fault windows end) straight off the report.
+    breaker: Optional[dict] = None
 
     @property
     def cycles_per_sec(self) -> float:
@@ -142,6 +146,8 @@ class SimReport:
             "flight_dumps": list(self.flight_dumps),
             "trace_out": self.trace_out,
             **({"soak": self.soak} if self.soak is not None else {}),
+            **({"breaker": self.breaker} if self.breaker is not None
+               else {}),
         }
 
 
@@ -184,8 +190,31 @@ class ClusterSimulator:
         # Validate BEFORE mutating process state: a bad fault spec must
         # not leak env overrides or a live cache thread pool.
         fault_spec = parse_fault_spec(cfg.faults)
+        # Device-fault kinds fire inside the device-solve
+        # materialization and the canary probe; the native backend
+        # never dispatches either, so such a run would count injected
+        # faults while exercising nothing — reject it like an unknown
+        # kind rather than green-lighting a vacuous chaos run.
+        device_kinds = [
+            k for k in ("solver-exc", "solver-hang", "backend-loss")
+            if fault_spec.get(k)
+        ]
+        if cfg.backend == "native" and device_kinds:
+            raise ValueError(
+                f"fault kinds {device_kinds} require a device backend "
+                "(dense/sparse); --backend native never runs a device "
+                "solve, so they would inject nothing"
+            )
         self._env_backup: Dict[str, Optional[str]] = {}
         self._apply_backend_env(cfg.backend, cfg.topk)
+        # Fault-containment state is process-global; a run must start
+        # from a closed breaker and must not inherit (or leak) a device
+        # fault hook — breaker state bleeding from a recording run into
+        # its replay would silently desynchronize them.
+        from ..solver import containment as _containment
+
+        self._containment = _containment
+        _containment.reset_breaker()
         try:
             self.cluster = InProcessCluster(simulate_kubelet=True)
             self.cache = SchedulerCache(
@@ -207,6 +236,29 @@ class ClusterSimulator:
                 schedule_period=cfg.period,
                 clock=self.clock,
             )
+            # Small REAL-time solve budget, stamped AFTER the Scheduler
+            # (whose constructor stamps the period-derived one): an
+            # injected hang costs a fraction of a second of wall time,
+            # not the production 30 s. Only when device faults are
+            # actually planned — the deadline measures WALL time, and a
+            # fault-free (or native) soak on a contended box must not
+            # turn a >0.5 s scheduling stall of a healthy solve into a
+            # SolveTimeout cycle error. The hook is the chaos seam the
+            # solver-exc/solver-hang/backend-loss kinds fire through.
+            if device_kinds:
+                _containment.configure(solve_budget=0.5)
+            _containment.set_device_fault_hook(
+                self.injector.device_fault_hook()
+            )
+            if cfg.backend in ("dense", "sparse"):
+                # Pre-warm the breaker's canary jit so an in-run probe
+                # costs milliseconds against the 0.5 s budget — probe
+                # success must never hinge on a cold compile racing the
+                # deadline (that would make replays timing-dependent).
+                try:
+                    _containment._canary_probe(timeout=60.0)
+                except Exception:
+                    logger.exception("sim canary prewarm failed")
             self.checker = InvariantChecker()
             # Soak runs stream the trace to disk without the in-memory
             # record list (O(cycles) RAM the leak detector would —
@@ -222,6 +274,12 @@ class ClusterSimulator:
         except BaseException:
             if getattr(self, "cache", None) is not None:
                 self.cache.shutdown()
+            # Undo the process-global containment stamps made above —
+            # close() is unreachable when __init__ raises, and a leaked
+            # 0.5 s wall-clock budget / fault hook would poison later
+            # solves in the same process.
+            _containment.set_device_fault_hook(None)
+            _containment.configure(None)
             self._restore_env()
             raise
 
@@ -282,6 +340,8 @@ class ClusterSimulator:
         try:
             self.cache.shutdown()
         finally:
+            self._containment.set_device_fault_hook(None)
+            self._containment.configure(None)
             self.writer.close()
             if self._tracing:
                 try:
@@ -304,6 +364,7 @@ class ClusterSimulator:
                 self._run_cycle(cycle)
                 self.clock.advance(cfg.period)
             self.report.cycles = cfg.cycles
+            self.report.breaker = self._containment.BREAKER.state_dict()
             if cfg.soak:
                 self._finish_soak()
         finally:
@@ -371,6 +432,7 @@ class ClusterSimulator:
         # 2. faults
         doomed: List[str] = []
         solver_fault = crash_fault = False
+        device_fault = None  # "exc" | "hang" for this cycle's solves
         for fault in fault_events:
             kind = fault["kind"]
             self.report.fault_counts[kind] = (
@@ -391,9 +453,20 @@ class ClusterSimulator:
                 solver_fault = True
             elif kind == "crash":
                 crash_fault = True
+            elif kind == "solver-exc":
+                device_fault = "exc"
+            elif kind == "solver-hang":
+                # A planned hang wins over a planned exception: it
+                # exercises the strictly harsher path (deadline
+                # abandonment + immediate quarantine).
+                device_fault = "hang"
+            elif kind == "backend-loss":
+                self.injector.note_backend_loss(cycle, fault["down_for"])
 
         # 3. one real scheduling cycle
-        self.injector.begin_cycle(cycle, doomed_nodes=doomed)
+        self.injector.begin_cycle(
+            cycle, doomed_nodes=doomed, solver_fault=device_fault
+        )
         prev_solver = None
         if solver_fault:
             prev_solver = os.environ.get("KBT_SOLVER")
