@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke bench-compare verify ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke bench-compare verify ci image clean
 
 all: native
 
@@ -84,6 +84,18 @@ chaos-smoke:
 		--faults "solver-exc:0.08,solver-hang:0.02,bind:0.05" \
 		--fail-on-cycle-errors --quiet
 
+# Micro-cycle smoke: the chaos-smoke fault storm with event-driven
+# micro cycles carrying placement between periodic cycles (periodic
+# every 4th virtual cycle, warm-path micro cycles in between). The
+# degradation ladder and breaker (PR 7) must contain the injected
+# solver faults on the micro path too, and the invariant checker runs
+# every cycle — exit 1 on any violation, 3 on any cycle error.
+micro-smoke:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim --cycles 250 --seed 11 \
+		--backend dense --micro-every 4 \
+		--faults "solver-exc:0.08,solver-hang:0.02,bind:0.05" \
+		--fail-on-cycle-errors --quiet
+
 # Bench regression sentinel across the two newest committed bench
 # rounds (noise-aware: canary-normalized thresholds + the explicit
 # allowlist), THEN its own self-test: an injected 20% cycle_ms
@@ -117,7 +129,7 @@ verify:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify native test bench-smoke sim-smoke soak-smoke chaos-smoke bench-compare
+ci: verify native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

@@ -466,8 +466,8 @@ def bench_cycle(cfg, seed=0, cache=None, trace_path=None,
     new_groups = max(1, n_groups // 100)
     per_group = n_tasks // n_groups
 
-    def add_burst(prefix):
-        for g in range(new_groups):
+    def add_burst(prefix, groups=None):
+        for g in range(groups if groups is not None else new_groups):
             name = f"{prefix}{g}"
             cache.add_pod_group(build_pod_group(
                 name, namespace="bench",
@@ -504,8 +504,89 @@ def bench_cycle(cfg, seed=0, cache=None, trace_path=None,
     finally:
         containment.BREAKER.unpin()
     degraded["spans"] = spans_since(mark)
+
+    # --- steady_warm: the warm-started 1%-churn steady state ---------
+    # Each round: a ~1% gang burst arrives, the next cycle places it
+    # through the warm-start plan (solver/warm.py) — incremental
+    # tensorize, selection-cache reuse, residual capacities. The cycle
+    # AFTER the last burst absorbs its placement wave as a warm no-op.
+    # Reported per-round + median; `warm_outcome`/`tensorize_incremental`
+    # are the acceptance flags (warm must ENGAGE, the placement wave
+    # must never trip a full rebuild).
+    one_cycle()  # settle the degraded round's wave; re-warms the state
+    warm_rounds = []
+    for r in range(5):
+        add_burst(f"pgw{r}_")
+        warm_rounds.append(one_cycle())
+    absorb = one_cycle()
+    warm_med = sorted(
+        r["cycle_ms"] for r in warm_rounds
+    )[len(warm_rounds) // 2]
+    steady_warm = {
+        "cycle_ms": round(warm_med, 3),
+        "rounds_ms": [round(r["cycle_ms"], 3) for r in warm_rounds],
+        "warm_outcome": warm_rounds[-1].get("warm_outcome"),
+        "warm_engaged": all(
+            r.get("warm_outcome") in ("solve", "noop")
+            for r in warm_rounds
+        ),
+        "tensorize_incremental": all(
+            r.get("tensorize_incremental", False) for r in warm_rounds
+        ),
+        "tensorize_wave_patched": warm_rounds[-1].get(
+            "tensorize_wave_patched"
+        ),
+        "placed_per_round": [r.get("placed", 0) for r in warm_rounds],
+        "sparse_engaged": warm_rounds[-1].get("sparse_engaged"),
+        "absorb_cycle_ms": absorb["cycle_ms"],
+        "absorb_warm_outcome": absorb.get("warm_outcome"),
+        "open_ms": warm_rounds[-1].get("open_ms"),
+        "action_ms": warm_rounds[-1].get("action_ms"),
+        "close_ms": warm_rounds[-1].get("close_ms"),
+        "tensorize_ms": warm_rounds[-1].get("tensorize_ms"),
+        "solve_ms": warm_rounds[-1].get("solve_ms"),
+        "apply_ms": warm_rounds[-1].get("apply_ms"),
+    }
+
+    # --- micro_cycle: arrival-to-placement latency ------------------
+    # The event-driven fast path (Scheduler.run_micro semantics: full
+    # session, micro flag, warm-path-only placement) measured from the
+    # moment a burst lands in the mirror to its placements applied, at
+    # ~0.1% and ~1% churn.
+    def micro_round(prefix, burst_tasks):
+        groups = max(1, burst_tasks // per_group)
+        add_burst(prefix, groups=groups)
+        from kube_batch_tpu.utils import deferred_gc as _dgc
+
+        t0 = time.perf_counter()
+        with _dgc():
+            ssn = open_session(cache, make_tiers(*TIERS_ARGS))
+            ssn.micro_cycle = True
+            action.execute(ssn)
+            close_session(ssn)
+            # Stop the clock INSIDE the guard: the deferred collection
+            # at guard exit belongs to think-time, exactly as in
+            # one_cycle()/Scheduler.run_once.
+            ms = (time.perf_counter() - t0) * 1e3
+        stats = dict(_atpu.last_stats)
+        cache.wait_for_side_effects(timeout=120.0)
+        one_cycle()  # absorb the wave before the next round
+        return {
+            "arrival_to_placement_ms": round(ms, 3),
+            "burst_tasks": groups * per_group,
+            "placed": stats.get("placed", 0),
+            "warm_outcome": stats.get("warm_outcome"),
+            "deferred": stats.get("micro_deferred"),
+        }
+
+    micro_cycle = {
+        "burst_0p1": micro_round("pgm1_", max(1, n_tasks // 1000)),
+        "burst_1p": micro_round("pgm2_", max(1, n_tasks // 100)),
+    }
+
     out = {"cold": cold, "steady": steady, "idle": idle, "delta": delta,
-           "degraded": degraded}
+           "degraded": degraded, "steady_warm": steady_warm,
+           "micro_cycle": micro_cycle}
     if tracing:
         out["trace_path"] = TRACER.export(trace_path)
         out["trace_spans"] = TRACER.spans_recorded
@@ -865,6 +946,28 @@ def run_smoke():
     if not engaged:
         print("bench-smoke: sparse path did NOT engage", file=sys.stderr)
         sys.exit(4)
+    # Steady-cycle assertion (mirror of the sparse-engaged check): the
+    # cycle after a placement wave must ride the incremental tensorize —
+    # a full_reason there means the wave dirtied its way past the
+    # narrow-ledger patching, the exact regression the warm-start work
+    # removed (ROADMAP item 1 / the retired cycle.steady.cycle_ms
+    # allowlist entry).
+    steady = cycle.get("steady", {})
+    warm = cycle.get("steady_warm", {})
+    steady_ok = (
+        steady.get("tensorize_incremental", True)
+        and "tensorize_full_reason" not in steady
+        and warm.get("warm_engaged", False)
+        and warm.get("tensorize_incremental", False)
+    )
+    if not steady_ok:
+        print(
+            "bench-smoke: steady cycle did NOT stay incremental "
+            f"(steady={ {k: v for k, v in steady.items() if 'tensorize' in k} }, "
+            f"warm_engaged={warm.get('warm_engaged')})",
+            file=sys.stderr,
+        )
+        sys.exit(5)
 
 
 def main():

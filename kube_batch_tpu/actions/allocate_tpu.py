@@ -335,6 +335,11 @@ class AsyncSolveHandle:
 
 
 class AllocateTpuAction(Action):
+    # Eligible for the scheduler's event-driven micro cycles
+    # (Scheduler.run_micro): in micro mode the action places only
+    # through the warm-start plan and defers otherwise.
+    micro_capable = True
+
     def __init__(self, max_rounds: int = 256):
         self.max_rounds = max_rounds
 
@@ -478,6 +483,74 @@ class AllocateTpuAction(Action):
             use_native = True
             breaker_pinned = True
             last_stats["breaker_pinned"] = True
+
+        # --- warm-start plan (solver/warm.py) -------------------------
+        # Decide how much of the previous cycle's solve survives BEFORE
+        # tensorize: a ``noop`` outcome skips the task side, selection,
+        # solve, and apply outright (the previous verdicts are this
+        # cycle's verdicts, bit-for-bit); ``solve`` means the problem is
+        # exactly the new work against residual capacities; any other
+        # outcome is a labeled full-solve fallback.
+        from ..solver import warm as warm_mod
+
+        micro = bool(getattr(ssn, "micro_cycle", False))
+        warm_outcome, _warm_live = warm_mod.plan_warm(ssn)
+        last_stats["warm_outcome"] = warm_outcome
+        if micro and warm_outcome not in ("noop", "solve"):
+            # Micro cycles place ONLY through the warm path: a plan
+            # fallback means a full solve, which belongs to the
+            # periodic cycle (the fairness/preempt authority). Place
+            # nothing and defer.
+            last_stats["micro_deferred"] = warm_outcome
+            metrics.register_warm_start(warm_outcome)
+            metrics.register_micro_cycle("deferred")
+            return
+        if warm_outcome == "noop":
+            t0 = time.perf_counter()
+            with span("tensorize"):
+                tensorize(ssn, warm_noop=True)
+            _record_phase("tensorize", (time.perf_counter() - t0) * 1e3)
+            from ..solver.snapshot import last_tensorize_stats
+
+            ts = dict(last_tensorize_stats)
+            drift = ts.get("incremental") is False or (
+                ts.get("dirty_nodes", 0) != ts.get("wave_patched", 0)
+            )
+            for k, v in ts.items():
+                last_stats[f"tensorize_{k}"] = v
+            if not drift:
+                warm_mod.advance_noop(ssn)
+                metrics.register_warm_start("noop")
+                if micro:
+                    metrics.register_micro_cycle("noop")
+                try:
+                    from ..obs import explain
+
+                    explain.record_idle_cycle(ssn)
+                except Exception:  # pragma: no cover - forensics only
+                    logger.exception("idle-cycle verdict GC failed")
+                RECORDER.annotate("solver", {
+                    "warm": "noop",
+                    "tensorize_wave_patched": ts.get("wave_patched"),
+                })
+                return
+            # Node rows moved beyond the narrow ledger: a session-side
+            # mutation the plan could not see. Void the carried state
+            # and fall through to the full solve (the arrays are clean
+            # now; the re-tensorize below is cheap). In a MICRO cycle
+            # the fallthrough is not allowed — same contract as the
+            # plan-time fallbacks above: place nothing, defer the full
+            # solve to the periodic cycle.
+            warm_outcome = "drift"
+            last_stats["warm_outcome"] = warm_outcome
+            warm_mod.invalidate(ssn.cache)
+            if micro:
+                last_stats["micro_deferred"] = warm_outcome
+                metrics.register_warm_start(warm_outcome)
+                metrics.register_micro_cycle("deferred")
+                return
+        metrics.register_warm_start(warm_outcome)
+
         t0 = time.perf_counter()
         with span("tensorize"):
             try:
@@ -523,6 +596,13 @@ class AllocateTpuAction(Action):
                 explain.record_idle_cycle(ssn)
             except Exception:  # pragma: no cover - forensics only
                 logger.exception("idle-cycle verdict GC failed")
+            # An idle cycle leaves the strongest warm state there is:
+            # zero carried verdicts.
+            last_stats["warm_carried"] = warm_mod.save_warm_state(
+                ssn, None, None
+            )
+            if micro:
+                metrics.register_micro_cycle("noop")
             return
         if breaker_pinned:
             # Counted here, not at the gate: the metric's documented
@@ -882,11 +962,20 @@ class AllocateTpuAction(Action):
                 logger.exception("verdict recording failed")
                 reason_counts = {}
         last_stats["verdicts_ms"] = (time.perf_counter() - t0) * 1e3
+        # Warm-state save: this solve's unassigned remainder becomes the
+        # carried-verdict set the next cycle's plan checks against.
+        last_stats["warm_carried"] = warm_mod.save_warm_state(
+            ssn, ctx, assigned
+        )
+        if micro:
+            metrics.register_micro_cycle("solve")
         RECORDER.annotate("solver", {
             "backend": backend,
             "rounds": rounds,
             "placed": placed,
             "tasks": len(ctx.tasks),
+            "warm": warm_outcome,
+            "warm_carried": last_stats["warm_carried"],
             # Fault-containment attribution: the rung sequence this
             # cycle actually ran (one entry per attempt), the breaker's
             # state after it, and the last ladder descent — the flight

@@ -28,6 +28,18 @@ class ClusterInfo:
         # churn came from and size the expected patch work.
         self.dirty_jobs: frozenset = frozenset()
         self.dirty_nodes: frozenset = frozenset()
+        # NARROW subsets (disjoint from the full sets above): names
+        # whose only mutations were the scheduler's own bind
+        # bookkeeping — known allocation deltas. The delta-aware
+        # tensorize patches exactly those columns (idle + task count)
+        # instead of treating the row as arbitrarily dirty.
+        self.dirty_jobs_narrow: frozenset = frozenset()
+        self.dirty_nodes_narrow: frozenset = frozenset()
+        # Monotone snapshot generation (SchedulerCache._snap_gen) — the
+        # warm-solve continuity token — and the cache-maintained sum of
+        # ready-node allocatables (None when the cache predates it).
+        self.snap_gen: int = 0
+        self.total_allocatable = None
 
     def __repr__(self) -> str:
         return (

@@ -204,6 +204,53 @@ class SchedulerCache(Cache, EventHandlersMixin):
         # the cheap churn ledger the incremental tensorize stats report.
         self._dirty_jobs: set = set()
         self._dirty_nodes: set = set()
+        # NARROW ledger: names whose only mutations since the last
+        # snapshot were the scheduler's OWN bind bookkeeping (idle/used/
+        # task-count moved by exactly the per-node deltas the apply
+        # phase computed; releasing/capacity/labels/taints untouched,
+        # job scalar-resource names untouched). Third-party watch
+        # events stamp the FULL sets above; snapshot() reports
+        # narrow = narrow - full so a name that saw both stays
+        # conservatively full-dirty. Consumed by the delta-aware
+        # tensorize + predicate caches (solver/snapshot.py,
+        # plugins/predicates.py) to patch only the changed columns
+        # instead of tripping the bulk-dirty full rebuild.
+        self._dirty_jobs_alloc: set = set()
+        self._dirty_nodes_alloc: set = set()
+        # FULL-dirty backlog: names drained by snapshot() but not yet
+        # ABSORBED by a tensorize refresh (cache.note_full_absorbed).
+        # A cycle can drain the ledger and then never tensorize (a
+        # deferred micro cycle, an error before the action, no ready
+        # nodes) — if the dropped full-dirty name were later stamped
+        # narrow, the delta-aware patch would treat a third-party
+        # mutation as allocation-only and leave releasing/capacity/
+        # static-verdict columns stale. The backlog keeps reporting a
+        # name FULL until a refresh actually consumed it.
+        self._full_backlog_jobs: set = set()
+        self._full_backlog_nodes: set = set()
+        # Monotone snapshot generation: the warm-solve state machine
+        # (solver/warm.py) requires CONSECUTIVE snapshots — a cycle
+        # whose ledger drained without a warm save invalidates the
+        # carried verdicts.
+        self._snap_gen = 0
+        # Incremental-snapshot state: the previous snapshot's job/node
+        # dicts (reused + delta-patched), the running sum of ready-node
+        # allocatables, and the aligned verification fingerprint
+        # (_SnapFingerprint) that detects EXACTLY which mirror objects
+        # or pool clones moved since — no trust in any reporting.
+        self._last_snap_jobs: Optional[Dict[str, JobInfo]] = None
+        self._last_snap_nodes: Optional[Dict[str, NodeInfo]] = None
+        self._snap_total_allocatable = None
+        self._snap_fp: Optional[tuple] = None
+        self._snap_fp_priority_gen = -1
+        # Priority-class generation: job priority is resolved from the
+        # class map at snapshot time, so any class change forces the
+        # full pool walk (the per-job priority recheck).
+        self._priority_gen = 0
+        # Event-driven micro-cycles: an arrival listener (Scheduler.run
+        # installs a threading.Event setter) fired whenever a pending
+        # pod of ours lands in the mirror.
+        self._arrival_listener = None
 
         self._executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="cache-sideeffect"
@@ -539,6 +586,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 terminated = job_terminated(job)
                 if terminated:
                     self.jobs.pop(job.uid, None)
+                    # Removal must reach the incremental snapshot's
+                    # delta set or the stale entry outlives the job.
+                    self._stamp_dirty(job.uid)
                     removed += 1
             if terminated:
                 self._forget_job_metrics(job)
@@ -557,6 +607,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 terminated = job_terminated(job)
                 if terminated:
                     self.jobs.pop(job.uid, None)
+                    self._stamp_dirty(job.uid)
             if terminated:
                 self._forget_job_metrics(job)
             else:
@@ -609,55 +660,282 @@ class SchedulerCache(Cache, EventHandlersMixin):
             )
         with self.mutex:
             snap = ClusterInfo()
-            pool_jobs: Dict[str, tuple] = {}
-            pool_nodes: Dict[str, tuple] = {}
-            old_jobs, old_nodes = self._snap_pool
-            for name, node in self.nodes.items():
-                if not node.ready():
-                    continue
-                entry = old_nodes.get(name)
-                if (
-                    entry is not None
-                    and entry[0] == node._ver
-                    and entry[2] == entry[1]._ver
-                ):
-                    pool_nodes[name] = entry
-                else:
-                    entry = pool_nodes[name] = _pool_entry(node)
-                snap.nodes[name] = entry[1]
+            if (
+                self._snap_fp is not None
+                and self._snap_fp_priority_gen == self._priority_gen
+                and os.environ.get("KBT_SNAPSHOT_INCREMENTAL", "1") != "0"
+            ):
+                self._snapshot_incremental(snap)
+            else:
+                self._snapshot_full(snap)
             for name, q in self.queues.items():
                 snap.queues[name] = q.clone()
-            for key, job in self.jobs.items():
-                # Jobs without a scheduling spec (neither PodGroup nor the
-                # legacy PDB source) are not schedulable
-                # (reference cache.go:634-640).
-                if job.pod_group is None and job.pdb is None:
-                    continue
-                if self.enable_priority_class and job.pod_group is not None:
-                    job.priority = self.default_priority
-                    pc = self.priority_classes.get(
-                        job.pod_group.spec.priority_class_name
-                    )
-                    if pc is not None:
-                        job.priority = pc.value
-                entry = old_jobs.get(key)
-                if (
-                    entry is not None
-                    and entry[0] == job._ver
-                    and entry[2] == entry[1]._ver
-                    and entry[1].priority == job.priority
-                ):
-                    pool_jobs[key] = entry
-                else:
-                    entry = pool_jobs[key] = _pool_entry(job)
-                snap.jobs[key] = entry[1]
-            # Entries for deleted objects fall away with the pool swap.
-            self._snap_pool = (pool_jobs, pool_nodes)
-            snap.dirty_jobs = frozenset(self._dirty_jobs)
-            snap.dirty_nodes = frozenset(self._dirty_nodes)
+            self._snap_gen += 1
+            snap.snap_gen = self._snap_gen
+            total = self._snap_total_allocatable
+            snap.total_allocatable = (
+                total.clone() if total is not None else None
+            )
+            # Fold this interval's full-dirty names into the backlog;
+            # report the WHOLE backlog (names stay full-dirty until a
+            # refresh absorbs them — see note_full_absorbed). A name
+            # that ALSO saw a third-party event, now or in any
+            # un-absorbed interval, stays conservatively full-dirty.
+            self._full_backlog_jobs |= self._dirty_jobs
+            self._full_backlog_nodes |= self._dirty_nodes
+            snap.dirty_jobs = frozenset(self._full_backlog_jobs)
+            snap.dirty_nodes = frozenset(self._full_backlog_nodes)
+            snap.dirty_jobs_narrow = frozenset(
+                self._dirty_jobs_alloc - self._full_backlog_jobs
+            )
+            snap.dirty_nodes_narrow = frozenset(
+                self._dirty_nodes_alloc - self._full_backlog_nodes
+            )
             self._dirty_jobs.clear()
             self._dirty_nodes.clear()
+            self._dirty_jobs_alloc.clear()
+            self._dirty_nodes_alloc.clear()
             return snap
+
+    def note_full_absorbed(self, job_keys, node_names) -> None:
+        """A tensorize refresh ran against a session carrying these
+        full-dirty names: drop them from the backlog (called by
+        solver/snapshot._store_refresh_stats). Names stamped since that
+        session's snapshot live in the live ledger, not the backlog, so
+        this never forgets fresh churn."""
+        with self.mutex:
+            self._full_backlog_jobs.difference_update(job_keys)
+            self._full_backlog_nodes.difference_update(node_names)
+
+    def _job_priority(self, job: JobInfo) -> None:
+        """Resolve job priority from the class map (cache.go:641-650)."""
+        if self.enable_priority_class and job.pod_group is not None:
+            job.priority = self.default_priority
+            pc = self.priority_classes.get(
+                job.pod_group.spec.priority_class_name
+            )
+            if pc is not None:
+                job.priority = pc.value
+
+    def _snapshot_full(self, snap: ClusterInfo) -> None:
+        """The reference-shaped pool walk: touch every mirror object,
+        re-cloning any whose source or clone fingerprint moved. Also
+        (re)establishes the incremental baseline: the last-snapshot
+        dicts, the ready-node allocatable running sum, and the
+        verification fingerprint."""
+        from ..api import Resource
+
+        pool_jobs: Dict[str, tuple] = {}
+        pool_nodes: Dict[str, tuple] = {}
+        old_jobs, old_nodes = self._snap_pool
+        total = Resource.empty()
+        for name, node in self.nodes.items():
+            if not node.ready():
+                continue
+            entry = old_nodes.get(name)
+            if (
+                entry is not None
+                and entry[0] == node._ver
+                and entry[2] == entry[1]._ver
+            ):
+                pool_nodes[name] = entry
+            else:
+                entry = pool_nodes[name] = _pool_entry(node)
+            snap.nodes[name] = entry[1]
+            total.add(entry[1].allocatable)
+        for key, job in self.jobs.items():
+            # Jobs without a scheduling spec (neither PodGroup nor the
+            # legacy PDB source) are not schedulable
+            # (reference cache.go:634-640).
+            if job.pod_group is None and job.pdb is None:
+                continue
+            self._job_priority(job)
+            entry = old_jobs.get(key)
+            if (
+                entry is not None
+                and entry[0] == job._ver
+                and entry[2] == entry[1]._ver
+                and entry[1].priority == job.priority
+            ):
+                pool_jobs[key] = entry
+            else:
+                entry = pool_jobs[key] = _pool_entry(job)
+            snap.jobs[key] = entry[1]
+        # Entries for deleted objects fall away with the pool swap.
+        self._snap_pool = (pool_jobs, pool_nodes)
+        self._last_snap_jobs = dict(snap.jobs)
+        self._last_snap_nodes = dict(snap.nodes)
+        self._snap_total_allocatable = total
+        self._refresh_snap_fingerprint()
+
+    def _refresh_snap_fingerprint(self) -> None:
+        """Rebuild the aligned verification lists over the CURRENT
+        mirror + pool state (called after every full walk). Object
+        references are pinned in the lists — identity compares against
+        them are exact witnesses (a pinned object's id can never be
+        recycled under a new object)."""
+
+        def fp(mirror: dict, pool: dict):
+            names = list(mirror.keys())
+            objs = list(mirror.values())
+            vers = [o._ver for o in objs]
+            entries = [pool.get(name) for name in names]
+            clone_vers = [
+                e[1]._ver if e is not None else -1 for e in entries
+            ]
+            return [names, objs, vers, entries, clone_vers]
+
+        pool_jobs, pool_nodes = self._snap_pool
+        self._snap_fp = (
+            fp(self.jobs, pool_jobs), fp(self.nodes, pool_nodes)
+        )
+        self._snap_fp_priority_gen = self._priority_gen
+
+    def _snapshot_incremental(self, snap: ClusterInfo) -> None:
+        """O(churn) pool update behind an exact O(n)-cheap verification:
+        C-level list compares of per-object (identity, _ver) and
+        per-pool-entry (identity via pinned reference, clone _ver)
+        against the previous snapshot's fingerprint find EXACTLY the
+        names whose mirror object or session clone moved — no trust in
+        the dirty ledger or any caller-side reporting, so a test poking
+        objects directly is caught like any watch event. Only those
+        names re-run the pool walk body; everything else reuses its
+        entry untouched. Key APPENDS (new pods/jobs/nodes) extend the
+        fingerprint in place; a deletion or reorder falls back to the
+        full walk, as does any priority-class change.
+        KBT_SNAPSHOT_INCREMENTAL=0 forces the full walk every cycle."""
+        job_fp, node_fp = self._snap_fp
+        pool_jobs, pool_nodes = self._snap_pool
+
+        def dirty_positions(fp, mirror, pool):
+            names, objs, vers, entries, clone_vers = fp
+            n = len(names)
+            if len(mirror) < n:
+                return None  # deletion: full walk
+            cur_objs = list(mirror.values())
+            appended = []
+            if len(cur_objs) > n:
+                # Python dicts append new keys at the end; if the first
+                # n entries are untouched, the tail is pure arrival.
+                cur_names = list(mirror.keys())
+                if cur_names[:n] != names:
+                    return None
+                appended = list(range(n, len(cur_names)))
+                names.extend(cur_names[n:])
+                objs.extend(cur_objs[n:])
+                vers.extend(o._ver for o in cur_objs[n:])
+                entries.extend([None] * len(appended))
+                clone_vers.extend([-1] * len(appended))
+                cur_objs = cur_objs[:n]
+            head_objs = objs[:n] if appended else objs
+            idxs = []
+            if not (cur_objs == head_objs
+                    and vers[:n] == [o._ver for o in cur_objs]):
+                idxs = [
+                    i for i, o in enumerate(cur_objs)
+                    if head_objs[i] is not o or vers[i] != o._ver
+                ]
+                if list(mirror.keys())[:n] != names[:n]:
+                    return None  # replacement/reorder: full walk
+                for i in idxs:
+                    objs[i] = cur_objs[i]
+                    vers[i] = cur_objs[i]._ver
+            # Session clones mutate without touching the mirror object:
+            # the pinned entry references read the CURRENT clone _ver.
+            if clone_vers[:n] != [
+                e[1]._ver if e is not None else -1 for e in entries[:n]
+            ]:
+                seen = set(idxs)
+                for i in range(n):
+                    e = entries[i]
+                    cv = e[1]._ver if e is not None else -1
+                    if cv != clone_vers[i] and i not in seen:
+                        idxs.append(i)
+            return sorted(idxs) + appended
+
+        node_idxs = dirty_positions(node_fp, self.nodes, pool_nodes)
+        job_idxs = dirty_positions(job_fp, self.jobs, pool_jobs)
+        if node_idxs is None or job_idxs is None:
+            self._snapshot_full(snap)
+            return
+        dirty_node_names = [node_fp[0][i] for i in node_idxs]
+        dirty_job_keys = [job_fp[0][i] for i in job_idxs]
+
+        nodes_out = self._last_snap_nodes
+        jobs_out = self._last_snap_jobs
+        total = self._snap_total_allocatable
+        for pos, name in zip(node_idxs, dirty_node_names):
+            # In-place assignment (never pop+reinsert for a live name):
+            # dict position IS the snapshot row order the tensorize
+            # caches key on — reordering would read as node-set churn.
+            prev = nodes_out.get(name)
+            if prev is not None:
+                total.sub(prev.allocatable)
+            node = self.nodes[name]
+            if not node.ready():
+                nodes_out.pop(name, None)
+                pool_nodes.pop(name, None)
+                self._fp_patch(node_fp, pos, None)
+                continue
+            entry = pool_nodes.get(name)
+            if not (
+                entry is not None
+                and entry[0] == node._ver
+                and entry[2] == entry[1]._ver
+            ):
+                entry = pool_nodes[name] = _pool_entry(node)
+            nodes_out[name] = entry[1]
+            total.add(entry[1].allocatable)
+            self._fp_patch(node_fp, pos, entry)
+
+        for pos, key in zip(job_idxs, dirty_job_keys):
+            job = self.jobs[key]
+            if job.pod_group is None and job.pdb is None:
+                pool_jobs.pop(key, None)
+                jobs_out.pop(key, None)
+                self._fp_patch(job_fp, pos, None)
+                continue
+            self._job_priority(job)
+            entry = pool_jobs.get(key)
+            if not (
+                entry is not None
+                and entry[0] == job._ver
+                and entry[2] == entry[1]._ver
+                and entry[1].priority == job.priority
+            ):
+                entry = pool_jobs[key] = _pool_entry(job)
+            jobs_out[key] = entry[1]
+            self._fp_patch(job_fp, pos, entry)
+
+        # Hand out copies: sessions mutate their dicts (_validate_jobs
+        # deletes invalid jobs; _close rebinds but tests may poke).
+        snap.jobs = dict(jobs_out)
+        snap.nodes = dict(nodes_out)
+        snap.incremental = True
+
+    @staticmethod
+    def _fp_patch(fp, pos: int, entry) -> None:
+        """Re-point one verification-fingerprint position at the pool
+        entry the walk just (re)minted — the mirror-side lists were
+        already adopted during verification."""
+        fp[3][pos] = entry
+        fp[4][pos] = entry[2] if entry is not None else -1
+
+    # -- event-driven micro-cycles ------------------------------------------
+
+    def set_arrival_listener(self, listener) -> None:
+        """Install ``listener()`` fired (outside the mutex) whenever a
+        pending pod of this scheduler lands in the mirror — the
+        micro-cycle wake-up signal (scheduler.run_micro)."""
+        self._arrival_listener = listener
+
+    def _notify_arrival(self) -> None:
+        listener = self._arrival_listener
+        if listener is not None:
+            try:
+                listener()
+            except Exception:  # pragma: no cover - listener is advisory
+                logger.exception("arrival listener failed")
 
     # -- side effects --------------------------------------------------------
 
@@ -690,7 +968,11 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 f"failed to bind Task {task.uid} to host {hostname}: "
                 f"host does not exist"
             )
-        self._stamp_dirty(task_info.job, hostname)
+        # NARROW stamp: a bind applies exactly the deltas the scheduler
+        # itself computed (idle/used/count on the node, a status-index
+        # move on the job) — the delta-aware tensorize patches those
+        # columns instead of rebuilding the row (solver/snapshot.py).
+        self._stamp_dirty_alloc(task_info.job, hostname)
         if task.status not in (TaskStatus.PENDING, TaskStatus.ALLOCATED):
             raise ValueError(
                 f"failed to bind Task {task.uid}: status is "

@@ -49,6 +49,18 @@ class EventHandlersMixin:
         if node_name:
             self._dirty_nodes.add(node_name)
 
+    def _stamp_dirty_alloc(self, job_key: Optional[str] = None,
+                           node_name: Optional[str] = None) -> None:
+        """NARROW stamp for the scheduler's own bind bookkeeping: the
+        mutation is a known allocation delta (node idle/used/task-count,
+        job status-index move), never a spec/labels/releasing/capacity
+        change. snapshot() subtracts the full sets, so a name that also
+        saw a third-party event stays conservatively full-dirty."""
+        if job_key:
+            self._dirty_jobs_alloc.add(job_key)
+        if node_name:
+            self._dirty_nodes_alloc.add(node_name)
+
     # ---- pods (reference event_handlers.go:45-262) -------------------------
 
     def _get_or_create_job(self, ti: TaskInfo) -> Optional[JobInfo]:
@@ -169,6 +181,17 @@ class EventHandlersMixin:
             return
         with self.mutex:
             self._add_pod_locked(pod)
+        # Micro-cycle wake-up (outside the mutex): a pending pod of ours
+        # is new schedulable work — the event-driven fast path places it
+        # without waiting for the periodic cycle (scheduler.run_micro).
+        from ..api import PodPhase
+
+        if (
+            pod.spec.scheduler_name == self.scheduler_name
+            and pod.status.phase == PodPhase.PENDING
+            and not pod.spec.node_name
+        ):
+            self._notify_arrival()
 
     def _stored_task(self, ti: TaskInfo) -> TaskInfo:
         """Resolve to the cache's own TaskInfo (handles Binding status drift,
@@ -178,17 +201,57 @@ class EventHandlersMixin:
             return job.tasks[ti.uid]
         return ti
 
+    def _allocated_status_flip(self, old_ti: TaskInfo,
+                               new_ti: TaskInfo) -> bool:
+        """True iff this pod MODIFIED event is a pure in-place status
+        confirmation of a placement the scheduler already made — the
+        kubelet flipping a bound pod to Running, or the API server
+        confirming a bind: same pod on the same node, both statuses in
+        the allocated family, identical resource requests. Such an
+        event changes NO state the solver reads (node idle/releasing/
+        count and job pending sets are all invariant), so it stamps the
+        NARROW ledger — without this, every bind confirmation re-dirties
+        its node fully one cycle later and the warm path can never
+        engage against a live API server."""
+        from ..api import allocated_status
+
+        return bool(
+            old_ti.uid == new_ti.uid
+            and old_ti.node_name
+            and old_ti.node_name == new_ti.node_name
+            and allocated_status(old_ti.status)
+            and allocated_status(new_ti.status)
+            and old_ti.resreq == new_ti.resreq
+            and old_ti.init_resreq == new_ti.init_resreq
+        )
+
     def update_pod(self, old_pod: Pod, new_pod: Pod) -> None:
         """reference event_handlers.go:128-133 (deletePod + addPod)"""
         if not self._accept_pod(new_pod):
             return
         with self.mutex:
             old_ti = self._stored_task(TaskInfo(old_pod))
+            narrow = self._allocated_status_flip(old_ti, TaskInfo(new_pod))
+            job_key = self._effective_job_key(old_ti)
+            node_name = old_ti.node_name
+            if narrow:
+                # Only demote stamps THIS event minted: a name already
+                # full-dirty from an earlier event stays full-dirty.
+                pre_job = job_key in self._dirty_jobs
+                pre_node = node_name in self._dirty_nodes
             try:
                 self._delete_task(old_ti)
             except KeyError:
+                narrow = False
                 pass
             self._add_pod_locked(new_pod)
+            if narrow:
+                if not pre_job:
+                    self._dirty_jobs.discard(job_key)
+                    self._dirty_jobs_alloc.add(job_key)
+                if not pre_node:
+                    self._dirty_nodes.discard(node_name)
+                    self._dirty_nodes_alloc.add(node_name)
 
     def delete_pod(self, pod: Pod) -> None:
         """reference event_handlers.go:162-180"""
@@ -340,9 +403,14 @@ class EventHandlersMixin:
             self.default_priority_class = pc
             self.default_priority = pc.value
         self.priority_classes[pc.name] = pc
+        # Job priorities are resolved from this map at snapshot time, so
+        # a class change invalidates the incremental snapshot's premise
+        # that untouched jobs kept their priority.
+        self._priority_gen += 1
 
     def _delete_priority_class_locked(self, pc: PriorityClass) -> None:
         if pc.global_default:
             self.default_priority_class = None
             self.default_priority = 0
         self.priority_classes.pop(pc.name, None)
+        self._priority_gen += 1

@@ -93,13 +93,26 @@ class Session:
         self.backlog: List[JobInfo] = []
         self.tiers: List[Tier] = tiers or []
         # Churn ledger from the cache snapshot (names touched since the
-        # previous snapshot) — observability for incremental tensorize.
+        # previous snapshot) — observability for incremental tensorize,
+        # and (with the narrow subsets + generation) the warm-start
+        # plan's delta preconditions (solver/warm.py).
         self.dirty_jobs: frozenset = frozenset()
         self.dirty_nodes: frozenset = frozenset()
+        self.dirty_jobs_narrow: frozenset = frozenset()
+        self.dirty_nodes_narrow: frozenset = frozenset()
+        self.snap_gen: int = 0
+        self._snap_total_allocatable = None
+        # Event-driven micro cycle flag (Scheduler.run_micro): actions
+        # place only through the warm path when set.
+        self.micro_cycle = False
         # The allocate_tpu AsyncSolveHandle currently in flight, if any
         # (drain guard: Statement boundaries and session close block on
         # it so no transaction or teardown races an outstanding solve).
         self._inflight_solve = None
+        # Jobs whose conditions this session rewrote (update_job_condition)
+        # — their close-time status write-back can never take the
+        # unchanged-fingerprint skip.
+        self._conditioned_jobs: set = set()
 
         self._total_allocatable: Optional[Resource] = None
         self.plugins: Dict[str, object] = {}
@@ -139,13 +152,34 @@ class Session:
         self.queues = snapshot.queues
         self.dirty_jobs = getattr(snapshot, "dirty_jobs", frozenset())
         self.dirty_nodes = getattr(snapshot, "dirty_nodes", frozenset())
+        self.dirty_jobs_narrow = getattr(
+            snapshot, "dirty_jobs_narrow", frozenset()
+        )
+        self.dirty_nodes_narrow = getattr(
+            snapshot, "dirty_nodes_narrow", frozenset()
+        )
+        self.snap_gen = getattr(snapshot, "snap_gen", 0)
+        self._snap_total_allocatable = getattr(
+            snapshot, "total_allocatable", None
+        )
 
     def _validate_jobs(self) -> None:
         """Drop invalid jobs, persisting an Unschedulable condition
         (reference session.go:89-108). Called after plugins are opened so
         JobValid callbacks are installed."""
         for job in list(self.jobs.values()):
+            # Fingerprint memo: a job that passed validation last cycle
+            # and has not been mutated since passes again (JobValid
+            # callbacks are pure functions of job state). Only PASSING
+            # verdicts are memoized — invalid jobs re-run the full path
+            # (condition write-back carries this session's transition
+            # id). The attr lives on the clone, which the COW pool only
+            # reuses while untouched, so a fresh clone self-invalidates.
+            if getattr(job, "_valid_ok_ver", None) == job._ver:
+                continue
             vr = self.job_valid(job)
+            if vr is None or vr.passed:
+                job._valid_ok_ver = job._ver
             if vr is not None and not vr.passed:
                 cond = PodGroupCondition(
                     type=POD_GROUP_CONDITION_UNSCHEDULABLE,
@@ -162,13 +196,29 @@ class Session:
 
     def _close(self) -> None:
         """reference session.go:119-144"""
+        conditioned = self._conditioned_jobs
         for job in self.jobs.values():
             if job.pod_group is None:
                 self.cache.record_job_status_event(job)
                 continue
+            # Status write-back memo: an untouched job's recomputed
+            # PodGroup status is identical to what the last close wrote
+            # (status is a pure function of the task-status index, and
+            # the unschedulable-condition term only fires for jobs
+            # conditioned THIS session — tracked separately). The attr
+            # lives on the clone; any mutation re-clones or bumps _ver.
+            if (
+                getattr(job, "_status_synced_ver", None) == job._ver
+                and job.uid not in conditioned
+                # An UNKNOWN phase decays once its condition's session
+                # passes (the transition-id term) — never memoized.
+                and job.pod_group.status.phase != PodGroupPhase.UNKNOWN
+            ):
+                continue
             job.pod_group.status = self._job_status(job)
             try:
                 self.cache.update_job_status(job)
+                job._status_synced_ver = job._ver
             except Exception:
                 logger.exception(
                     "failed to update job <%s/%s>", job.namespace, job.name
@@ -255,9 +305,14 @@ class Session:
         Returns a fresh clone per call; callers own the result."""
         total = self._total_allocatable
         if total is None:
-            total = Resource.empty()
-            for node in self.nodes.values():
-                total.add(node.allocatable)
+            # The cache maintains this sum across snapshots (O(churn)
+            # adjustments in the pool walk); only a pre-maintenance
+            # snapshot pays the O(nodes) accumulation here.
+            total = self._snap_total_allocatable
+            if total is None:
+                total = Resource.empty()
+                for node in self.nodes.values():
+                    total.add(node.allocatable)
             self._total_allocatable = total
         return total.clone()
 
@@ -688,6 +743,7 @@ class Session:
             raise KeyError(
                 f"failed to find job <{job_info.namespace}/{job_info.name}>"
             )
+        self._conditioned_jobs.add(job_info.uid)
         if job.pod_group is None:
             # Legacy PDB-sourced jobs have no PodGroup to carry conditions
             # (the reference would nil-deref here, session.go:368 — we log

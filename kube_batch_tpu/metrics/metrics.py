@@ -299,6 +299,34 @@ tensorize_dirty_rows = REGISTRY.register(
         "Node rows patched by incremental tensorize",
     )
 )
+tensorize_wave_patches = REGISTRY.register(
+    Counter(
+        "tensorize_wave_patches_total",
+        "Node rows patched through the allocation-only (placement "
+        "wave) path: idle + task-count columns only, driven by the "
+        "narrow dirty ledger",
+    )
+)
+scheduler_micro_cycles = REGISTRY.register(
+    Counter(
+        "scheduler_micro_cycles_total",
+        "Event-driven micro cycles by outcome: solve (warm placement "
+        "made), noop (nothing to place), deferred (warm plan could "
+        "not engage; left to the periodic cycle)",
+    ),
+    ("outcome",),
+)
+solver_warm_starts = REGISTRY.register(
+    Counter(
+        "solver_warm_starts_total",
+        "Warm-start plan outcomes per solving cycle: noop (previous "
+        "verdicts reused bit-for-bit, solve skipped), solve (new work "
+        "only, residual capacities), or the full-solve fallback reason "
+        "(cold/stale/node-dirty/releasing/carried-changed/"
+        "deserved-changed/carried-interleave/drift/disabled)",
+    ),
+    ("outcome",),
+)
 device_cache_rows_patched = REGISTRY.register(
     Counter(
         "device_cache_rows_patched_total",
@@ -578,7 +606,8 @@ def update_solver_phase(phase: str, seconds: float) -> None:
 
 
 def update_tensorize_cycle(
-    incremental: bool, dirty_rows: int, full_reason=None
+    incremental: bool, dirty_rows: int, full_reason=None,
+    wave_patched: int = 0,
 ) -> None:
     """Record one tensorize node-array refresh: which path ran and how
     many rows it actually touched."""
@@ -588,6 +617,16 @@ def update_tensorize_cycle(
     # rows but ships through the rebuild path, not the patch path.
     if incremental and dirty_rows:
         tensorize_dirty_rows.inc(amount=float(dirty_rows))
+    if incremental and wave_patched:
+        tensorize_wave_patches.inc(amount=float(wave_patched))
+
+
+def register_warm_start(outcome: str) -> None:
+    solver_warm_starts.inc((outcome,))
+
+
+def register_micro_cycle(outcome: str) -> None:
+    scheduler_micro_cycles.inc((outcome,))
 
 
 def update_device_cache(stats: dict) -> None:

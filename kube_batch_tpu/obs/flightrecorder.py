@@ -78,9 +78,11 @@ class FlightRecorder:
 
     # -- per-cycle lifecycle ------------------------------------------------
 
-    def begin_cycle(self, cycle=None) -> dict:
+    def begin_cycle(self, cycle=None, kind: str = "periodic") -> dict:
         """Open this cycle's record; phases and annotations accumulate
-        into it until :meth:`end_cycle` commits it to the ring."""
+        into it until :meth:`end_cycle` commits it to the ring.
+        ``kind`` distinguishes the periodic loop from the event-driven
+        micro-cycle fast path (``periodic`` | ``micro``)."""
         with self._lock:
             prev = self._open
             if prev is not None:
@@ -93,6 +95,7 @@ class FlightRecorder:
             rec = {
                 "seq": self._seq,
                 "cycle": cycle if cycle is not None else self._seq - 1,
+                "cycle_kind": kind,
                 "t_start": time.time(),
                 "phase": "start",
                 "phases_ms": {},

@@ -165,8 +165,8 @@ class _PredNodeCache:
     rest."""
 
     __slots__ = (
-        "flags", "node_objs", "node_vers", "node_ok", "has_taints",
-        "sig_rows",
+        "flags", "node_objs", "node_ids", "node_vers", "node_ok",
+        "has_taints", "static_ok", "sig_rows",
     )
     # Retention bound for template rows whose signature did not appear
     # in the current batch (kept warm so alternating bursts reuse them).
@@ -175,9 +175,14 @@ class _PredNodeCache:
     def __init__(self):
         self.flags = None
         self.node_objs = None
+        self.node_ids = None
         self.node_vers = None
         self.node_ok = None
         self.has_taints = None
+        # Watch-object-only half of node_ok (conditions, cordon,
+        # pressure): invariant under the scheduler's own placements, so
+        # narrow-dirty nodes recompute only the live pod-count cap.
+        self.static_ok = None
         self.sig_rows = {}
 
 
@@ -308,8 +313,10 @@ class PredicatesPlugin(Plugin):
             flags = (mem_enable, disk_enable, pid_enable)
 
             def node_verdict(node):
-                """(schedulable, has_taints) for one node — exactly the
-                pre-incremental per-node loop body."""
+                """(schedulable, has_taints, static_ok) for one node —
+                exactly the pre-incremental per-node loop body, with the
+                watch-object-only half exposed for the narrow-churn
+                fast path."""
                 knode = node.node
                 if knode is None:
                     # No backing object: evaluate directly (the checks
@@ -318,7 +325,7 @@ class PredicatesPlugin(Plugin):
                         check_node_condition(None, node)
                         check_node_unschedulable(None, node)
                     except PredicateError:
-                        return False, False
+                        return False, False, False
                     has_taints = False
                 else:
                     # Unlike pod specs, node specs/conditions are
@@ -352,12 +359,12 @@ class PredicatesPlugin(Plugin):
                             ok,
                             bool(knode.spec.taints),
                         )
-                    if not cached[1]:
-                        return False, False
                     has_taints = cached[2]
+                    if not cached[1]:
+                        return False, has_taints, False
                 if 0 < node.allocatable.max_task_num <= len(node.tasks):
-                    return False, has_taints
-                return True, has_taints
+                    return False, has_taints, True
+                return True, has_taints, True
 
             # Cross-cycle columns (see _PredNodeCache): dirty nodes are
             # the fingerprint misses; only their verdicts re-run. A
@@ -374,38 +381,76 @@ class PredicatesPlugin(Plugin):
                         cache_host._pred_batch_cache = pc
                     except Exception:
                         pc = None
+            # Shared per-tensorize node scan (solver/snapshot): the
+            # (identity, _ver) arrays are computed once per cycle and
+            # reused here when the caller passed its exact node list.
+            scan = getattr(ssn, "_kbt_node_scan", None)
+            if scan is not None and scan.nodes is nodes:
+                cur_ids, cur_vers = scan.ids, scan.vers
+            else:
+                cur_ids = np.fromiter(map(id, nodes), np.int64, count=N)
+                cur_vers = np.fromiter(
+                    (n._ver for n in nodes), np.int64, count=N
+                )
             if (
                 pc is None
                 or pc.node_objs is None
+                or pc.node_ids is None
                 or pc.flags != flags
+                or pc.static_ok is None
                 or len(pc.node_objs) != N
             ):
                 node_ok = np.empty(N, dtype=bool)
                 has_taints_col = np.empty(N, dtype=bool)
-                dirty = range(N)
+                static_ok_col = np.empty(N, dtype=bool)
+                dirty = list(range(N))
+                recheck = dirty
                 prev_sig_rows = {}
             else:
                 node_ok = pc.node_ok
                 has_taints_col = pc.has_taints
-                objs, vers = pc.node_objs, pc.node_vers
-                # C-level clean-path check (identity short-circuit);
-                # see solver/snapshot._refresh_node_arrays.
-                if objs == nodes and vers == [n._ver for n in nodes]:
-                    dirty = []
-                else:
-                    dirty = [
-                        j for j, n in enumerate(nodes)
-                        if objs[j] is not n or vers[j] != n._ver
-                    ]
+                static_ok_col = pc.static_ok
+                dirty = np.nonzero(
+                    (cur_ids != pc.node_ids)
+                    | (cur_vers != pc.node_vers)
+                )[0].tolist()
                 prev_sig_rows = pc.sig_rows
-            for j in dirty:
-                node_ok[j], has_taints_col[j] = node_verdict(nodes[j])
+                # NARROW split: rows whose only churn was the
+                # scheduler's own placements keep their watch-object
+                # verdict and taint/selector columns — only the live
+                # pod-count cap can move. Their sig-row columns need a
+                # re-verdict ONLY when that cap flipped node_ok.
+                narrow = getattr(ssn, "dirty_nodes_narrow", None)
+                if dirty and narrow:
+                    recheck = []
+                    for j in dirty:
+                        n = nodes[j]
+                        if n.name in narrow:
+                            ok = bool(static_ok_col[j]) and not (
+                                0 < n.allocatable.max_task_num
+                                <= len(n.tasks)
+                            )
+                            if ok != node_ok[j]:
+                                # Pod-count cap flipped the verdict:
+                                # fall through to the full re-verdict
+                                # so the sig-row columns re-derive too.
+                                recheck.append(j)
+                        else:
+                            recheck.append(j)
+                else:
+                    recheck = dirty
+            for j in recheck:
+                (
+                    node_ok[j], has_taints_col[j], static_ok_col[j],
+                ) = node_verdict(nodes[j])
             if pc is not None and (dirty or pc.node_objs is None):
                 pc.flags = flags
                 pc.node_objs = list(nodes)
-                pc.node_vers = [n._ver for n in nodes]
+                pc.node_ids = cur_ids
+                pc.node_vers = cur_vers
                 pc.node_ok = node_ok
                 pc.has_taints = has_taints_col
+                pc.static_ok = static_ok_col
             tainted = np.nonzero(node_ok & has_taints_col)[0].tolist()
 
             def _terms_sig(terms):
@@ -503,11 +548,13 @@ class PredicatesPlugin(Plugin):
                 return row
 
             def patch_sig_row(row, rep, has_selaff):
-                """Re-verdict only the dirty columns of a cached row.
-                Column-for-column identical to build_sig_row: a not-ok
-                node's column resets to True (never evaluated), taints
-                then selector in order for the rest."""
-                for j in dirty:
+                """Re-verdict only the re-checked columns of a cached
+                row (narrow-churn columns with an unchanged verdict are
+                already exact). Column-for-column identical to
+                build_sig_row: a not-ok node's column resets to True
+                (never evaluated), taints then selector in order for
+                the rest."""
+                for j in recheck:
                     row[j] = True
                     if not node_ok[j]:
                         continue

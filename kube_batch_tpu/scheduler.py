@@ -244,6 +244,27 @@ class Scheduler:
         # leader elector's fence); fired from the watchdog thread.
         self.fence_hooks: List[Callable[[str], None]] = []
         self.watchdog: Optional[LoopWatchdog] = None
+        # Event-driven micro-cycles (KBT_MICRO=1 opts in): pod arrivals
+        # wake the loop during think time and a bounded fast path
+        # places them through the warm-start plan without waiting for
+        # the period (doc/design/cycle-pipeline.md). The periodic cycle
+        # remains the fairness/preempt authority — a micro cycle that
+        # cannot take the warm path places nothing.
+        self.micro_enabled = os.environ.get("KBT_MICRO", "0") == "1"
+        try:
+            self.micro_max_per_period = max(
+                1, int(os.environ.get("KBT_MICRO_MAX", "8"))
+            )
+        except ValueError:
+            self.micro_max_per_period = 8
+        try:
+            self.micro_batch_window = max(
+                0.0, float(os.environ.get("KBT_MICRO_BATCH_MS", "5")) / 1e3
+            )
+        except ValueError:
+            self.micro_batch_window = 0.005
+        self._micro_arrival = threading.Event()
+        self.micro_cycles_run = 0
         # KBT_TRACE_DIR arms the span tracer for the whole loop; the
         # trace file is written on loop exit and on cycle errors.
         maybe_enable_from_env()
@@ -322,6 +343,13 @@ class Scheduler:
             ACTIVE_WATCHDOG = self.watchdog
         self.cache.run(stop)
         self.cache.wait_for_cache_sync(stop)
+        if self.micro_enabled:
+            # Arm the arrival wake-up: pending pods of ours landing in
+            # the mirror set the event the think-time wait below parks
+            # on (cache/event_handlers.add_pod → _notify_arrival).
+            arm = getattr(self.cache, "set_arrival_listener", None)
+            if arm is not None:
+                arm(self._micro_arrival.set)
         while not stop.is_set():
             start = clock.now()
             if not self.run_once_guarded():
@@ -348,11 +376,116 @@ class Scheduler:
                             break
                 except Exception:
                     logger.exception("think-time side-effect drain failed")
+                if self.micro_enabled:
+                    self._micro_wait(stop, deadline)
                 remaining = max(0.0, deadline - time.perf_counter())
             clock.wait(stop, remaining)
         # Loop exit with tracing armed (KBT_TRACE_DIR): persist the
         # buffered spans so an operator-stopped run leaves a trace.
         export_trace(tag="trace")
+
+    def _micro_wait(self, stop, deadline: float) -> None:
+        """Think-time tail with event-driven placement: park on the
+        arrival event until the period deadline; each wake-up runs one
+        bounded micro cycle (after a short coalescing window so a gang's
+        pod burst lands in one cycle), at most ``micro_max_per_period``
+        per period. A micro-cycle error falls through to the normal
+        per-cycle error accounting — the periodic loop's backoff is not
+        engaged (the next periodic cycle is the recovery authority)."""
+        used = 0
+        while not stop.is_set() and used < self.micro_max_per_period:
+            left = deadline - time.perf_counter()
+            if left <= 0:
+                return
+            if not self._micro_arrival.wait(timeout=left):
+                return
+            if self.micro_batch_window > 0:
+                stop.wait(self.micro_batch_window)
+            self._micro_arrival.clear()
+            used += 1
+            try:
+                self.run_micro()
+            except Exception:  # pragma: no cover - guarded inside
+                logger.exception("micro cycle failed")
+
+    def run_micro(self) -> bool:
+        """One event-driven micro cycle: the allocate fast path between
+        periodic cycles. Opens a REAL session (full plugin state — the
+        placements it makes are exactly what the periodic cycle would
+        have made) but runs only the micro-capable actions, each told
+        via ``ssn.micro_cycle`` to place ONLY through the warm-start
+        plan: if the plan cannot engage, the cycle places nothing and
+        defers to the next periodic cycle, which remains the
+        fairness/preempt authority. Returns True iff the cycle
+        completed without error."""
+        cycle = self._cycle_count
+        self._cycle_count += 1
+        TRACER.begin_cycle(cycle)
+        RECORDER.begin_cycle(cycle, kind="micro")
+        if self.watchdog is not None:
+            self.watchdog.cycle_begin(cycle)
+        cycle_start = time.perf_counter()
+        ok = True
+        try:
+            with span("cycle"):
+                with deferred_gc():
+                    RECORDER.phase("open_session")
+                    t0 = time.perf_counter()
+                    with span("open_session"):
+                        ssn = open_session(self.cache, self.tiers)
+                    ssn.micro_cycle = True
+                    RECORDER.phase_done(
+                        "open_session", (time.perf_counter() - t0) * 1e3
+                    )
+                    try:
+                        for action in self.actions:
+                            if not getattr(action, "micro_capable", False):
+                                continue
+                            name = action.name()
+                            RECORDER.phase(f"action:{name}")
+                            action_start = time.perf_counter()
+                            with span(f"action:{name}"):
+                                action.initialize()
+                                action.execute(ssn)
+                                action.un_initialize()
+                            elapsed = time.perf_counter() - action_start
+                            metrics.update_action_duration(name, elapsed)
+                            RECORDER.phase_done(
+                                f"action:{name}", elapsed * 1e3
+                            )
+                    except BaseException:
+                        RECORDER.mark_failed_phase()
+                        raise
+                    finally:
+                        RECORDER.phase("close_session")
+                        t0 = time.perf_counter()
+                        with span("close_session"):
+                            close_session(ssn)
+                        RECORDER.phase_done(
+                            "close_session", (time.perf_counter() - t0) * 1e3
+                        )
+        except Exception as exc:
+            ok = False
+            metrics.register_cycle_error()
+            RECORDER.record_error(exc)
+            RECORDER.dump_on_error()
+            logger.exception("micro cycle failed")
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.cycle_end()
+        e2e = time.perf_counter() - cycle_start
+        metrics.update_e2e_duration(e2e)
+        RECORDER.phase("done")
+        rec = RECORDER.end_cycle(ok=ok, e2e_ms=round(e2e * 1e3, 3))
+        self.micro_cycles_run += 1
+        if self._telemetry:
+            try:
+                from .obs.telemetry import TELEMETRY
+
+                TELEMETRY.observe_scheduler_cycle(rec, cache=self.cache)
+            except Exception:
+                logger.exception("telemetry cycle feed failed")
+        return ok
 
     def _on_watchdog_trip(self, reason: str) -> None:
         """Fencing half of a watchdog trip: this (possibly wedged)

@@ -76,6 +76,11 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
                         default=True, help="skip the invariant checker")
     parser.add_argument("--fail-on-cycle-errors", action="store_true",
                         help="exit 3 if any scheduling cycle raised")
+    parser.add_argument(
+        "--micro-every", type=int, default=0, metavar="N",
+        help="event-driven micro-cycle mode: run the full periodic "
+             "cycle only every Nth sim cycle and the bounded warm-path "
+             "micro cycle in between (0 disables)")
     parser.add_argument("--quiet", action="store_true",
                         help="suppress the JSON report on stdout")
 
@@ -112,6 +117,7 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         trace_out=ns.trace_out,
         replay=replay,
         replay_limit=ns.replay_cycles,
+        micro_every=ns.micro_every,
         check_invariants=ns.check,
         soak=ns.soak,
         telemetry_out=ns.telemetry_out,

@@ -95,6 +95,13 @@ class SimConfig:
     # windows are dumped next to the trace (or to telemetry_out).
     soak: bool = False
     telemetry_out: Optional[str] = None
+    # Event-driven micro-cycle mode (--micro-every N, N >= 2): only
+    # every Nth sim cycle runs the full periodic scheduling cycle; the
+    # cycles in between run Scheduler.run_micro — the bounded warm-path
+    # fast cycle — against that cycle's arrivals. The invariant checker
+    # still runs EVERY cycle, so the micro path carries the same
+    # correctness obligations as the periodic one. 0 disables.
+    micro_every: int = 0
 
 
 @dataclass
@@ -182,6 +189,9 @@ class ClusterSimulator:
             cfg.seed = header.get("seed", cfg.seed)
             cfg.faults = header.get("faults", cfg.faults)
             cfg.period = header.get("period", cfg.period)
+            # The cycle-kind schedule (periodic vs micro) is part of
+            # the recorded run's semantics.
+            cfg.micro_every = header.get("micro_every", cfg.micro_every)
             cfg.cycles = len(cfg.replay.cycles)
             if cfg.replay_limit is not None:
                 cfg.cycles = min(cfg.cycles, max(1, cfg.replay_limit))
@@ -387,6 +397,7 @@ class ClusterSimulator:
                 "faults": cfg.faults,
                 "backend": cfg.backend,
                 "period": cfg.period,
+                "micro_every": cfg.micro_every,
                 "workload": cfg.workload.to_dict(),
             }
         self.writer.write(header)
@@ -463,7 +474,15 @@ class ClusterSimulator:
             elif kind == "backend-loss":
                 self.injector.note_backend_loss(cycle, fault["down_for"])
 
-        # 3. one real scheduling cycle
+        # 3. one real scheduling cycle. In micro mode only every Nth
+        # cycle is periodic; the rest run the bounded warm-path micro
+        # cycle (crash-fault cycles always run periodic so the injected
+        # crash action actually executes).
+        micro_cycle = (
+            cfg.micro_every > 1
+            and cycle % cfg.micro_every != 0
+            and not crash_fault
+        )
         self.injector.begin_cycle(
             cycle, doomed_nodes=doomed, solver_fault=device_fault
         )
@@ -476,7 +495,10 @@ class ClusterSimulator:
                 0, self.injector.crash_action_factory()
             )
         try:
-            ok = self.scheduler.run_once_guarded()
+            if micro_cycle:
+                ok = self.scheduler.run_micro()
+            else:
+                ok = self.scheduler.run_once_guarded()
         finally:
             if crash_fault:
                 self.scheduler.actions.pop(0)

@@ -257,18 +257,126 @@ class _TensorizeCache:
         "job_scalars",   # {job uid: (job, _ver, frozenset(scalar names))}
         "layout_sig",    # tuple(layout.scalars) the node arrays were built for
         "node_objs",     # [NodeInfo] in row order (pins identities)
-        "node_vers",     # [node._ver at build/patch time]
+        "node_ids",      # int64[N] id() per row (identities pinned above)
+        "node_vers",     # int64[N] node._ver at build/patch time
         "idle", "releasing", "cap",  # float64 [N, R]
         "count", "maxt",             # int32 [N]
+        # Node-side scalar-resource names, maintained as a per-row
+        # frozenset list + a multiset so dirty rows adjust it in O(row):
+        # the resource-layout scan no longer walks every node.
+        "node_scal_sets", "node_scal_counter", "node_scal_names",
     )
 
     def __init__(self):
         self.job_scalars = {}
         self.layout_sig = None
         self.node_objs = None
+        self.node_ids = None
         self.node_vers = None
         self.idle = self.releasing = self.cap = None
         self.count = self.maxt = None
+        self.node_scal_sets = None
+        self.node_scal_counter = None
+        self.node_scal_names = frozenset()
+
+
+class _NodeScan:
+    """One per-tensorize pass over the session's nodes: the ready row
+    list, its identity/version arrays, and the dirty-row positions
+    against the tensorize cache's baseline — shared by the node-array
+    refresh, the resource-layout scan, and (via ``ssn._kbt_node_scan``)
+    the predicates plugin's column cache, which all paid their own
+    O(N) attribute scans per cycle before."""
+
+    __slots__ = ("nodes", "ids", "vers", "dirty", "matched")
+
+    def __init__(self, nodes, ids, vers, dirty, matched):
+        self.nodes = nodes      # [NodeInfo] ready rows
+        self.ids = ids          # int64[N]
+        self.vers = vers        # int64[N]
+        # Row positions whose (identity, _ver) moved vs the tc baseline
+        # (None when the baseline is unusable: cold/set-change).
+        self.dirty = dirty
+        self.matched = matched  # baseline comparable (row count equal)
+
+
+def _build_node_scan(ssn, tc) -> _NodeScan:
+    """Build the shared node scan. Ready-phase filtering is applied
+    only to rows whose fingerprint moved: a row bit-identical to the
+    baseline was ready last cycle and every phase transition bumps
+    ``_ver`` (NodeInfo._set_node_state), so clean rows are ready by
+    induction. Also maintains the tc's node scalar-name multiset for
+    dirty rows (the layout scan consumes the aggregate)."""
+    vals = list(ssn.nodes.values())
+    n = len(vals)
+    ids = np.fromiter(map(id, vals), np.int64, count=n)
+    vers = np.fromiter((o._ver for o in vals), np.int64, count=n)
+    baseline_ok = (
+        tc is not None
+        and tc.node_objs is not None
+        and tc.node_ids is not None
+        and len(tc.node_objs) == n
+    )
+    if baseline_ok:
+        mism = (ids != tc.node_ids) | (vers != tc.node_vers)
+        dirty = np.nonzero(mism)[0].tolist()
+        ready = NodePhase.READY
+        if all(vals[j].state.phase == ready for j in dirty):
+            _maintain_node_scalars(tc, vals, dirty)
+            return _NodeScan(vals, ids, vers, dirty, True)
+    # Cold / set-change / a dirty row went not-ready: full filter, no
+    # usable baseline (the refresh takes its full-rebuild path).
+    nodes = _ready_nodes(ssn)
+    if len(nodes) != n:
+        n = len(nodes)
+        ids = np.fromiter(map(id, nodes), np.int64, count=n)
+        vers = np.fromiter((o._ver for o in nodes), np.int64, count=n)
+    else:
+        nodes = vals
+    if tc is not None:
+        _rebuild_node_scalars(tc, nodes)
+    return _NodeScan(nodes, ids, vers, None, False)
+
+
+def _row_scalar_set(node) -> frozenset:
+    sr = node.allocatable.scalar_resources
+    return frozenset(sr) if sr else frozenset()
+
+
+def _rebuild_node_scalars(tc, nodes) -> None:
+    from collections import Counter
+
+    sets = [_row_scalar_set(n) for n in nodes]
+    counter: Counter = Counter()
+    for s in sets:
+        counter.update(s)
+    tc.node_scal_sets = sets
+    tc.node_scal_counter = counter
+    tc.node_scal_names = frozenset(counter)
+
+
+def _maintain_node_scalars(tc, nodes, dirty) -> None:
+    if tc.node_scal_sets is None or len(tc.node_scal_sets) != len(nodes):
+        _rebuild_node_scalars(tc, nodes)
+        return
+    if not dirty:
+        return
+    sets, counter = tc.node_scal_sets, tc.node_scal_counter
+    changed = False
+    for j in dirty:
+        new = _row_scalar_set(nodes[j])
+        old = sets[j]
+        if new == old:
+            continue
+        changed = True
+        sets[j] = new
+        for name in old - new:
+            counter[name] -= 1
+            if counter[name] <= 0:
+                del counter[name]
+        counter.update(new - old)
+    if changed:
+        tc.node_scal_names = frozenset(counter)
 
 
 def _tensor_cache_of(cache) -> Optional[_TensorizeCache]:
@@ -284,25 +392,42 @@ def _tensor_cache_of(cache) -> Optional[_TensorizeCache]:
     return tc
 
 
-def _layout_for_session(ssn, tc: Optional[_TensorizeCache]) -> ResourceLayout:
+def _layout_for_session(
+    ssn, tc: Optional[_TensorizeCache], scan: Optional[_NodeScan] = None
+) -> ResourceLayout:
     """:meth:`ResourceLayout.for_session` with the per-job task scan
     memoized on the job fingerprint — steady-state cycles cost O(#jobs)
-    instead of O(all tasks). Scan semantics are identical (all jobs of
-    the session, every task's resreq + init_resreq, all node
-    allocatables)."""
+    instead of O(all tasks) — and the node-side scalar names maintained
+    by the shared node scan (O(dirty rows) instead of every node, every
+    cycle). Scan semantics are identical (all jobs of the session,
+    every task's resreq + init_resreq, all node allocatables)."""
     if tc is None:
         return ResourceLayout.for_session(ssn)
     names: set = set()
-    for node in ssn.nodes.values():
-        sr = node.allocatable.scalar_resources
-        if sr:
-            names.update(sr)
+    if scan is not None and tc.node_scal_sets is not None:
+        names.update(tc.node_scal_names)
+    else:
+        for node in ssn.nodes.values():
+            sr = node.allocatable.scalar_resources
+            if sr:
+                names.update(sr)
     cached = tc.job_scalars
+    narrow = getattr(ssn, "dirty_jobs_narrow", frozenset())
     fresh: Dict[str, tuple] = {}
     stale: List[tuple] = []
     for key, job in ssn.jobs.items():
         ent = cached.get(key)
         if ent is None or ent[0] is not job or ent[1] != job._ver:
+            # NARROW job churn (the scheduler's own bind bookkeeping):
+            # a status move never changes any task's resreq/init_resreq
+            # scalar names, so the cached name set is carried forward
+            # under a refreshed fingerprint instead of rescanning every
+            # task of a freshly re-cloned but scalar-identical job.
+            if ent is not None and key in narrow:
+                ent = (job, job._ver, ent[2])
+                fresh[key] = ent
+                names |= ent[2]
+                continue
             fresh[key] = None  # placeholder keeps insertion order
             stale.append((key, job))
         else:
@@ -338,15 +463,22 @@ def _fill_node_row(row: np.ndarray, r: Resource, scalars: List[str]) -> None:
         row[2 + k] = sr.get(name, 0.0) if sr else 0.0
 
 
-def _refresh_node_arrays(nodes, layout: ResourceLayout, tc):
+def _refresh_node_arrays(nodes, layout: ResourceLayout, tc,
+                         narrow_names=frozenset(), scan=None):
     """Columnar node state (float64 idle/releasing/cap + int32 counts),
     patched incrementally against the fingerprint cache. Falls back to a
-    full vectorized rebuild on layout change, node-set change, a cold
-    cache, or when most rows are dirty anyway (the vectorized build is
-    cheaper than per-row patching past ~25% dirty). Returns
-    ``(idle, releasing, cap, count, maxt, dirty_rows, full_reason)``;
-    the arrays are the CACHE's own — callers must copy before exposing
-    them beyond the current cycle."""
+    full vectorized rebuild on layout change, node-set change, or a cold
+    cache. Dirty rows are patched with the same VECTORIZED column fills
+    the full rebuild uses (scatter on the gathered dirty subset), so a
+    placement wave dirtying every node costs the same as a rebuild of
+    those rows — there is no bulk-dirty cliff anymore. Rows whose name
+    is in ``narrow_names`` (the cache's allocation-only ledger) patch
+    only the columns an allocation can move — idle and the task count —
+    skipping the releasing/capacity/max-task fills entirely; the count
+    of such rows is returned for the wave-patch metric. Returns
+    ``(idle, releasing, cap, count, maxt, dirty_rows, full_reason,
+    wave_patched)``; the arrays are the CACHE's own — callers must copy
+    before exposing them beyond the current cycle."""
     N = len(nodes)
     sig = tuple(layout.scalars)
     full_reason = None
@@ -360,20 +492,28 @@ def _refresh_node_arrays(nodes, layout: ResourceLayout, tc):
         full_reason = "node-set-change"
     dirty_idx: List[int] = []
     if full_reason is None:
-        objs, vers = tc.node_objs, tc.node_vers
-        # Fast clean-path check: list equality short-circuits per
-        # element at identity in C, ~5x cheaper than a Python loop
-        # building (id, ver) tuples for the common nothing-changed
-        # cycle.
-        if objs == nodes and vers == [n._ver for n in nodes]:
-            dirty_idx = []
+        if scan is not None and scan.matched and scan.nodes is nodes:
+            # The shared scan already diffed (identity, _ver) arrays
+            # against this cache's baseline.
+            dirty_idx = scan.dirty
         else:
-            dirty_idx = [
-                j for j, n in enumerate(nodes)
-                if objs[j] is not n or vers[j] != n._ver
-            ]
-            if dirty_idx and len(dirty_idx) * 4 > N:
-                full_reason = "bulk-dirty"
+            objs, vers = tc.node_objs, tc.node_vers
+            if tc.node_ids is None:
+                full_reason = "cold"
+            elif objs == nodes:
+                ver_arr = np.fromiter(
+                    (n._ver for n in nodes), np.int64, count=N
+                )
+                dirty_idx = np.nonzero(ver_arr != vers)[0].tolist()
+            else:
+                id_arr = np.fromiter(map(id, nodes), np.int64, count=N)
+                ver_arr = np.fromiter(
+                    (n._ver for n in nodes), np.int64, count=N
+                )
+                dirty_idx = np.nonzero(
+                    (id_arr != tc.node_ids) | (ver_arr != vers)
+                )[0].tolist()
+    wave_patched = 0
     if full_reason is not None:
         # Full vectorized rebuild, chunked across the rebuild pool on
         # big clusters (each chunk fills only its own rows).
@@ -405,22 +545,62 @@ def _refresh_node_arrays(nodes, layout: ResourceLayout, tc):
     else:
         idle, releasing, cap = tc.idle, tc.releasing, tc.cap
         count, maxt = tc.count, tc.maxt
-        scalars = layout.scalars
-        for j in dirty_idx:
-            n = nodes[j]
-            _fill_node_row(idle[j], n.idle, scalars)
-            _fill_node_row(releasing[j], n.releasing, scalars)
-            _fill_node_row(cap[j], n.allocatable, scalars)
-            count[j] = len(n.tasks)
-            maxt[j] = n.allocatable.max_task_num
+        if dirty_idx:
+            if narrow_names:
+                wave_idx = [
+                    j for j in dirty_idx
+                    if nodes[j].name in narrow_names
+                ]
+            else:
+                wave_idx = []
+            wave_patched = len(wave_idx)
+            if wave_patched != len(dirty_idx):
+                full_idx = (
+                    [j for j in dirty_idx
+                     if nodes[j].name not in narrow_names]
+                    if wave_idx else dirty_idx
+                )
+            else:
+                full_idx = []
+            if wave_idx:
+                # Allocation-only rows: one gathered column fill for
+                # idle + the task count; releasing/cap/max-task are
+                # untouched by a bind, by the narrow-ledger contract
+                # (cache/event_handlers._stamp_dirty_alloc).
+                wnodes = [nodes[j] for j in wave_idx]
+                idle[wave_idx] = _resource_matrix(
+                    [n.idle for n in wnodes], layout
+                )
+                count[wave_idx] = [len(n.tasks) for n in wnodes]
+            if full_idx:
+                fnodes = [nodes[j] for j in full_idx]
+                idle[full_idx] = _resource_matrix(
+                    [n.idle for n in fnodes], layout
+                )
+                releasing[full_idx] = _resource_matrix(
+                    [n.releasing for n in fnodes], layout
+                )
+                cap[full_idx] = _resource_matrix(
+                    [n.allocatable for n in fnodes], layout
+                )
+                count[full_idx] = [len(n.tasks) for n in fnodes]
+                maxt[full_idx] = [
+                    n.allocatable.max_task_num for n in fnodes
+                ]
         dirty = len(dirty_idx)
     if tc is not None and (full_reason is not None or dirty):
         tc.layout_sig = sig
         tc.node_objs = list(nodes)
-        tc.node_vers = [n._ver for n in nodes]
+        if scan is not None and scan.nodes is nodes:
+            tc.node_ids, tc.node_vers = scan.ids, scan.vers
+        else:
+            tc.node_ids = np.fromiter(map(id, nodes), np.int64, count=N)
+            tc.node_vers = np.fromiter(
+                (n._ver for n in nodes), np.int64, count=N
+            )
         tc.idle, tc.releasing, tc.cap = idle, releasing, cap
         tc.count, tc.maxt = count, maxt
-    return idle, releasing, cap, count, maxt, dirty, full_reason
+    return idle, releasing, cap, count, maxt, dirty, full_reason, wave_patched
 
 
 def _ready_nodes(ssn) -> List[NodeInfo]:
@@ -431,27 +611,78 @@ def _ready_nodes(ssn) -> List[NodeInfo]:
 
 
 def _store_refresh_stats(ssn, n_nodes: int, refreshed) -> None:
-    dirty_rows, full_reason = refreshed[5], refreshed[6]
+    dirty_rows, full_reason, wave_patched = (
+        refreshed[5], refreshed[6], refreshed[7]
+    )
     last_tensorize_stats.update(
         incremental=full_reason is None,
         dirty_nodes=dirty_rows,
         nodes=n_nodes,
+        # Rows patched through the allocation-only (wave) path.
+        wave_patched=wave_patched,
         # What the cache's own churn ledger expected (names touched
         # since the previous snapshot) — row-level truth is the clone
         # fingerprints, but divergence here flags session-side churn.
         cache_dirty_nodes=len(getattr(ssn, "dirty_nodes", ())),
         cache_dirty_jobs=len(getattr(ssn, "dirty_jobs", ())),
+        cache_narrow_nodes=len(getattr(ssn, "dirty_nodes_narrow", ())),
+        cache_narrow_jobs=len(getattr(ssn, "dirty_jobs_narrow", ())),
     )
     if full_reason is not None:
         last_tensorize_stats["full_reason"] = full_reason
+    # The refresh consumed this session's full-dirty names: clear them
+    # from the cache's backlog (they stop being reported full-dirty).
+    note = getattr(ssn.cache, "note_full_absorbed", None)
+    if note is not None:
+        note(
+            getattr(ssn, "dirty_jobs", ()) or (),
+            getattr(ssn, "dirty_nodes", ()) or (),
+        )
     try:
         from .. import metrics
 
         metrics.update_tensorize_cycle(
-            full_reason is None, dirty_rows, full_reason
+            full_reason is None, dirty_rows, full_reason,
+            wave_patched=wave_patched,
         )
     except Exception:  # pragma: no cover - metrics must never kill
         logger.exception("tensorize metrics export failed")
+
+
+def _absorb_dirty(ssn) -> None:
+    """Cache-maintenance half of a cycle that solves nothing (idle, or
+    a warm no-op): patch the node arrays and predicate columns against
+    the churn ledger so the NEXT real solve starts from a clean cache.
+    A truly quiet cycle (empty ledger, narrow included) is a no-op."""
+    if not (
+        getattr(ssn, "dirty_nodes", None)
+        or getattr(ssn, "dirty_jobs", None)
+        or getattr(ssn, "dirty_nodes_narrow", None)
+        or getattr(ssn, "dirty_jobs_narrow", None)
+    ):
+        return
+    tc = _tensor_cache_of(ssn.cache)
+    if tc is None:
+        return
+    scan = _build_node_scan(ssn, tc)
+    nodes = scan.nodes
+    if not nodes:
+        return
+    ssn._kbt_node_scan = scan
+    layout = _layout_for_session(ssn, tc, scan)
+    refreshed = _refresh_node_arrays(
+        nodes, layout, tc,
+        narrow_names=getattr(ssn, "dirty_nodes_narrow", frozenset()),
+        scan=scan,
+    )
+    _store_refresh_stats(ssn, len(nodes), refreshed)
+    for _name, fn in ssn.batch_predicates():
+        try:
+            fn([], nodes)
+        except Exception:
+            logger.exception(
+                "batch predicate %s failed on idle warm-up", _name,
+            )
 
 
 def _round_up(n: int, m: int) -> int:
@@ -476,6 +707,7 @@ def tensorize(
     include_jobs: Optional[List[JobInfo]] = None,
     pad=True,
     device=True,
+    warm_noop=False,
 ):
     """Build `(inputs, SnapshotContext)` for the session's pending,
     non-best-effort tasks, or ``(None, None)`` if there is nothing to solve.
@@ -506,6 +738,14 @@ def tensorize(
     from .masks import combine_masks, combine_score_rows
 
     last_tensorize_stats.clear()
+    if warm_noop:
+        # Warm no-op cycle (solver/warm.py): the warm plan proved every
+        # pending task keeps last cycle's verdict, so only the cycle's
+        # CACHE MAINTENANCE runs — node-array/predicate-column patching
+        # against the ledger — and the task side is skipped entirely.
+        _absorb_dirty(ssn)
+        last_tensorize_stats["warm_noop"] = True
+        return None, None
     job_pool = include_jobs if include_jobs is not None else ssn.jobs.values()
 
     # --- ordered task list: queue rank → job rank → task rank -------------
@@ -530,34 +770,25 @@ def tensorize(
         # (the warm predicate call with an empty batch refreshes that
         # plugin's node columns the same way). A truly idle cycle (empty
         # ledger) costs only the pending scan above.
-        if getattr(ssn, "dirty_nodes", None) or getattr(
-            ssn, "dirty_jobs", None
-        ):
-            tc = _tensor_cache_of(ssn.cache)
-            if tc is not None:
-                nodes = _ready_nodes(ssn)
-                if nodes:
-                    layout = _layout_for_session(ssn, tc)
-                    refreshed = _refresh_node_arrays(nodes, layout, tc)
-                    _store_refresh_stats(ssn, len(nodes), refreshed)
-                    for _name, fn in ssn.batch_predicates():
-                        try:
-                            fn([], nodes)
-                        except Exception:
-                            logger.exception(
-                                "batch predicate %s failed on idle "
-                                "warm-up", _name,
-                            )
+        _absorb_dirty(ssn)
         return None, None
 
-    nodes = _ready_nodes(ssn)
+    tc = _tensor_cache_of(ssn.cache)
+    scan = _build_node_scan(ssn, tc) if tc is not None else None
+    nodes = scan.nodes if scan is not None else _ready_nodes(ssn)
     if not nodes:
         return None, None
-    tc = _tensor_cache_of(ssn.cache)
-    layout = _layout_for_session(ssn, tc)
-    refreshed = _refresh_node_arrays(nodes, layout, tc)
+    # Hand the scan to the batch predicates (same (identity, _ver)
+    # diff, their own baseline) — they receive this exact node list.
+    ssn._kbt_node_scan = scan
+    layout = _layout_for_session(ssn, tc, scan)
+    refreshed = _refresh_node_arrays(
+        nodes, layout, tc,
+        narrow_names=getattr(ssn, "dirty_nodes_narrow", frozenset()),
+        scan=scan,
+    )
     (node_idle64, node_rel64, node_cap64, node_count, node_maxt,
-     _dirty_rows, _full_reason) = refreshed
+     _dirty_rows, _full_reason, _wave_patched) = refreshed
     _store_refresh_stats(ssn, len(nodes), refreshed)
 
     # Order only queues that HAVE jobs — the greedy loop discovers
@@ -768,6 +999,12 @@ def tensorize(
                 node_idle, node_cap, node_releasing,
                 node_task_count, node_max_tasks,
                 layout.eps(), lr_w, br_w, tk.k,
+                cache_holder=ssn.cache,
+                node_fp=(
+                    (scan.ids, scan.vers, scan.nodes)
+                    if scan is not None and scan.nodes is nodes
+                    else None
+                ),
             )
         if cand_sel is None:
             sparse_reason = "class-budget"

@@ -35,6 +35,7 @@ any problem size; ``0``/``off``/``dense`` disables sparsification.
 
 from __future__ import annotations
 
+import hashlib
 import os
 from dataclasses import dataclass
 from typing import Dict, Optional
@@ -52,7 +53,12 @@ from .kernels import (
 
 # Sparsification pays off once the dense [T, N] structures dominate and
 # the slab is a real subset; below these the dense solvers win outright.
-_SPARSE_MIN_TASKS = 8192
+# The task floor is a PRODUCT bound, not a task count: a 500-task
+# arrival batch against 5 000 nodes is 2.5 M dense score cells (~13 ms
+# native) where selection costs C·N for a handful of classes — exactly
+# the warm steady-cycle shape, so small-T/large-N problems sparsify too.
+_SPARSE_MIN_TASKS = 64
+_SPARSE_MIN_CELLS = 1 << 20
 _SPARSE_MIN_NODES = 1024
 DEFAULT_K = 64
 
@@ -96,7 +102,11 @@ def topk_config(n_tasks: int, n_nodes: int) -> TopKConfig:
     k = _pow2(k)
     if forced:
         return TopKConfig(k, True, "env-forced")
-    if n_tasks < _SPARSE_MIN_TASKS or n_nodes < _SPARSE_MIN_NODES:
+    if (
+        n_tasks < _SPARSE_MIN_TASKS
+        or n_nodes < _SPARSE_MIN_NODES
+        or n_tasks * n_nodes < _SPARSE_MIN_CELLS
+    ):
         return TopKConfig(k, False, "small-problem")
     if 4 * k >= n_nodes:
         return TopKConfig(k, False, "k-covers-nodes")
@@ -133,29 +143,131 @@ def _sel_hash(c_ids: np.ndarray, n_ids: np.ndarray) -> np.ndarray:
 def _dyn_score_np(req, idle, cap, lr_w, br_w):
     """[C, N] LeastRequested + Balanced in f32 NumPy — the selection
     mirror of kernels._dyn_score_core (selection quality only; kernel
-    rounds rescore against evolving idle on-device)."""
-    dims = (CPU_DIM, MEM_DIM)
-    req2 = req[:, None, :][..., dims].astype(np.float32)     # [C, 1, 2]
-    idle2 = idle[None, :, :][..., dims].astype(np.float32)   # [1, N, 2]
-    cap2 = cap[None, :, :][..., dims].astype(np.float32)
-    safe_cap = np.where(cap2 > 0, cap2, np.float32(1.0))
-    remaining = idle2 - req2
-    lr = np.where(
-        cap2 > 0,
-        np.maximum(remaining, 0.0) * np.float32(MAX_PRIORITY) / safe_cap,
-        np.float32(0.0),
-    )
-    lr_score = lr.mean(axis=-1)
-    frac = np.where(cap2 > 0, 1.0 - remaining / safe_cap, np.float32(1.0))
-    diff = np.abs(frac[..., 0] - frac[..., 1])
-    br_score = np.where(
-        (frac >= 1.0).any(axis=-1),
-        np.float32(0.0),
-        np.float32(MAX_PRIORITY) - diff * np.float32(MAX_PRIORITY),
-    )
+    rounds rescore against evolving idle on-device). Written as 2-D
+    per-dimension passes: the [C, N, 2] broadcast temporaries were most
+    of the selection pass's cost at warm steady-cycle shapes (small C,
+    large N)."""
+    ten = np.float32(MAX_PRIORITY)
+    lr_acc = None
+    fracs = []
+    over = None
+    for d in (CPU_DIM, MEM_DIM):
+        req_d = req[:, d:d + 1].astype(np.float32)        # [C, 1]
+        idle_d = idle[None, :, d].astype(np.float32)      # [1, N]
+        cap_d = cap[None, :, d].astype(np.float32)
+        pos = cap_d > 0
+        safe_cap = np.where(pos, cap_d, np.float32(1.0))
+        remaining = idle_d - req_d                        # [C, N]
+        lr = np.where(
+            pos, np.maximum(remaining, 0.0) * ten / safe_cap,
+            np.float32(0.0),
+        )
+        lr_acc = lr if lr_acc is None else lr_acc + lr
+        frac = np.where(pos, 1.0 - remaining / safe_cap, np.float32(1.0))
+        fracs.append(frac)
+        o = frac >= 1.0
+        over = o if over is None else (over | o)
+    lr_score = lr_acc * np.float32(0.5)
+    diff = np.abs(fracs[0] - fracs[1])
+    br_score = np.where(over, np.float32(0.0), ten - diff * ten)
     return (
         np.float32(lr_w) * lr_score + np.float32(br_w) * br_score
     ).astype(np.float32)
+
+
+class _SelectionCache:
+    """Cross-cycle per-class selection-key rows (stored on the
+    scheduler cache as ``_topk_sel_cache``).
+
+    A class's [N] integer key row is a pure function of (its feasibility
+    row, its req/fit rows, per-node idle/cap/count/max, eps, weights,
+    its class index). The feas/req/fit inputs are content-addressed by
+    digest; the node inputs by the shared node scan's (identity, _ver)
+    fingerprint — so a warm steady cycle recomputes each cached row
+    only at the columns whose node actually changed (the placement
+    wave), O(C·churn) instead of O(C·N). Any drift — new class shapes,
+    changed weights, an unfingerprintable call — misses to the exact
+    full computation, so cached and fresh selections are bit-identical
+    by construction."""
+
+    __slots__ = ("sig", "node_objs", "node_ids", "node_vers", "rows")
+
+    def __init__(self):
+        self.sig = None
+        # The fingerprinted node objects are PINNED here (like
+        # _TensorizeCache.node_objs): a pinned object's id can never be
+        # recycled under a new clone, so the id array stays an exact
+        # identity witness even across cycles where selection is
+        # skipped (warm-noop, dense-path, deferred micro) and the
+        # previous clones would otherwise be freed.
+        self.node_objs = None
+        self.node_ids = None
+        self.node_vers = None
+        self.rows: Dict[tuple, np.ndarray] = {}
+
+
+def _sel_cache_of(holder) -> Optional[_SelectionCache]:
+    if holder is None:
+        return None
+    sc = getattr(holder, "_topk_sel_cache", None)
+    if sc is None:
+        sc = _SelectionCache()
+        try:
+            holder._topk_sel_cache = sc
+        except Exception:
+            return None
+    return sc
+
+
+def _skey_block(req_rows, fit_rows, class_ids, cols,
+                idle32, cap32, eps32, cap_ok0, feas_cols,
+                lr_w, br_w):
+    """Integer selection keys for ``class_ids`` × ``cols`` (global node
+    indexes): eligibility-masked quantized score + class/node hash —
+    exactly the full pass's math on a column subset (elementwise ops
+    only, so subset and full computation are bit-identical)."""
+    R = req_rows.shape[1]
+    idle_c = idle32[cols]                              # [M, R]
+    cap_c = cap32[cols]
+    fit_ok = np.ones((req_rows.shape[0], len(cols)), dtype=bool)
+    for d in range(R):
+        fit_ok &= fit_rows[:, d:d + 1] - idle_c[None, :, d] < eps32[d]
+    elig = feas_cols & fit_ok & cap_ok0[cols][None, :]
+    score = _dyn_score_np(req_rows, idle_c, cap_c, lr_w, br_w)
+    q = np.clip(
+        np.round(score / np.float32(SCORE_QUANTUM)).astype(np.int64)
+        + _KEY_BIAS,
+        0, (1 << 20) - 1,
+    )
+    skey = (q << _KEY_HASH_BITS) | _sel_hash(
+        np.asarray(class_ids, np.int64)[:, None],
+        np.asarray(cols, np.int64)[None, :],
+    )
+    return np.where(elig, skey, -1)
+
+
+def _skey_priv_row(req_row, fit_row, class_id,
+                   idle32, cap32, eps32, cap_ok0, feas_row, srow,
+                   lr_w, br_w):
+    """One class's key row with its private static score row folded in
+    before quantization — the dense ``dynamic + static`` chain."""
+    R = req_row.shape[1]
+    N = idle32.shape[0]
+    fit_ok = np.ones((1, N), dtype=bool)
+    for d in range(R):
+        fit_ok &= fit_row[:, d:d + 1] - idle32[None, :, d] < eps32[d]
+    elig = feas_row & fit_ok & cap_ok0[None, :]
+    score = _dyn_score_np(req_row, idle32, cap32, lr_w, br_w) + srow
+    q = np.clip(
+        np.round(score / np.float32(SCORE_QUANTUM)).astype(np.int64)
+        + _KEY_BIAS,
+        0, (1 << 20) - 1,
+    )
+    skey = (q << _KEY_HASH_BITS) | _sel_hash(
+        np.asarray([class_id], np.int64)[:, None],
+        np.arange(N, dtype=np.int64)[None, :],
+    )
+    return np.where(elig, skey, -1)[0]
 
 
 def select_candidates(
@@ -172,6 +284,8 @@ def select_candidates(
     lr_weight: float,
     br_weight: float,
     k: int,
+    cache_holder=None,
+    node_fp=None,     # (ids i64[N], vers i64[N], [NodeInfo] pins) or None
 ) -> Optional[CandidateSet]:
     """Run the fused feasibility + static-score selection pass.
 
@@ -222,56 +336,144 @@ def select_candidates(
     cand_static = np.zeros((C, k), np.float32)
     cand_info = np.zeros((3, C), np.int32)
 
+    # Cross-cycle key-row cache (see _SelectionCache): usable only when
+    # the caller provided a node fingerprint and the cluster holds no
+    # Releasing capacity (the releasing column is not cached).
+    sc = _sel_cache_of(cache_holder) if node_fp is not None else None
+    changed_cols = None
+    sig = (N, int(k), R, eps32.tobytes(),
+           float(lr_weight), float(br_weight))
+    if sc is not None and not has_releasing:
+        ids, vers, node_objs = node_fp
+        if (
+            sc.sig == sig
+            and sc.node_ids is not None
+            and len(sc.node_ids) == N
+        ):
+            changed_cols = np.nonzero(
+                (ids != sc.node_ids) | (vers != sc.node_vers)
+            )[0]
+        else:
+            sc.rows = {}
+            changed_cols = None
+        sc.sig = sig
+        sc.node_objs = node_objs
+        sc.node_ids = ids
+        sc.node_vers = vers
+    elif sc is not None:
+        sc.rows = {}
+        sc.node_objs = None
+        sc.node_ids = None
+
     node_ids = np.arange(N, dtype=np.int64)
+    new_rows: Dict[tuple, np.ndarray] = {}
+    cache_hits = 0
     chunk = max(1, min(C, (1 << 22) // max(N, 1)))
     for c0 in range(0, C, chunk):
         c1 = min(c0 + chunk, C)
         rows = c1 - c0
         feas = mask.rows_for(rep_idx[c0:c1])                 # [rows, N]
-        fit_ok = np.all(
-            rep_fit[c0:c1][:, None, :] - idle32[None, :, :] < eps32,
-            axis=-1,
-        )
-        elig = feas & fit_ok & cap_ok0[None, :]
+        fit_chunk = rep_fit[c0:c1]
+        req_chunk = rep_req[c0:c1]
+
+        # Per-class cache resolution: digest the content inputs, reuse
+        # the cached key row with only the changed columns recomputed.
+        skey = None
+        row_keys = {}
+        misses = list(range(rows))
+        if sc is not None and not has_releasing:
+            skey = np.empty((rows, N), dtype=np.int64)
+            misses = []
+            hit_locals = []
+            for local in range(rows):
+                ci = c0 + local
+                if rep_priv[ci] >= 0:
+                    misses.append(local)  # private rows: never cached
+                    continue
+                key = (ci, hashlib.blake2b(
+                    feas[local].tobytes()
+                    + fit_chunk[local].tobytes()
+                    + req_chunk[local].tobytes(),
+                    digest_size=16,
+                ).digest())
+                row_keys[local] = key
+                row = (
+                    sc.rows.get(key) if changed_cols is not None else None
+                )
+                if row is None:
+                    misses.append(local)
+                    continue
+                skey[local] = row
+                hit_locals.append(local)
+            if hit_locals and changed_cols is not None and len(changed_cols):
+                sub = _skey_block(
+                    req_chunk[hit_locals], fit_chunk[hit_locals],
+                    [c0 + lo for lo in hit_locals], changed_cols,
+                    idle32, cap32, eps32, cap_ok0,
+                    feas[hit_locals][:, changed_cols],
+                    lr_weight, br_weight,
+                )
+                for i, local in enumerate(hit_locals):
+                    skey[local][changed_cols] = sub[i]
+            cache_hits += len(hit_locals)
+
+        # Singleton classes keep their private static score rows — the
+        # slab ships the gathered values so the kernel adds them exactly
+        # like the dense `dynamic + static` chain. Their key rows fold
+        # the addend into the score before quantization (never cached),
+        # computed individually so the bulk block never computes them
+        # twice.
+        srows = {}
+        if misses:
+            if skey is None:
+                skey = np.empty((rows, N), dtype=np.int64)
+            priv_misses = []
+            plain = []
+            for local in misses:
+                p = int(rep_priv[c0 + local])
+                if p >= 0 and p in score_rows_map:
+                    priv_misses.append((local, p))
+                else:
+                    plain.append(local)
+            if plain:
+                # Full computation for the plain miss rows — identical
+                # math to the cached path (elementwise ops on the full
+                # column set).
+                full = _skey_block(
+                    req_chunk[plain], fit_chunk[plain],
+                    [c0 + lo for lo in plain], node_ids,
+                    idle32, cap32, eps32, cap_ok0,
+                    feas[plain],
+                    lr_weight, br_weight,
+                )
+                for i, local in enumerate(plain):
+                    skey[local] = full[i]
+            for local, p in priv_misses:
+                srow = np.asarray(score_rows_map[p], np.float32)
+                srows[local] = srow
+                skey[local] = _skey_priv_row(
+                    req_chunk[local:local + 1],
+                    fit_chunk[local:local + 1], c0 + local,
+                    idle32, cap32, eps32, cap_ok0,
+                    feas[local:local + 1], srow,
+                    lr_weight, br_weight,
+                )
+
+        for local, key in row_keys.items():
+            new_rows[key] = skey[local].copy()
+
+        elig_count = (skey >= 0).sum(axis=1)
         cand_info[0, c0:c1] = np.minimum(
-            elig.sum(axis=1), np.iinfo(np.int32).max
+            elig_count, np.iinfo(np.int32).max
         )
         cand_info[1, c0:c1] = (feas & cap_ok0[None, :]).any(axis=1)
         if has_releasing:
-            rel_ok = np.all(
-                rep_fit[c0:c1][:, None, :] - rel32[None, :, :] < eps32,
-                axis=-1,
-            )
+            rel_ok = np.ones((rows, N), dtype=bool)
+            for d in range(R):
+                rel_ok &= (
+                    fit_chunk[:, d:d + 1] - rel32[None, :, d] < eps32[d]
+                )
             cand_info[2, c0:c1] = (rel_ok & feas).any(axis=1)
-
-        score = _dyn_score_np(
-            rep_req[c0:c1], idle32, cap32, lr_weight, br_weight
-        )
-        # Singleton classes keep their private static score rows — the
-        # slab ships the gathered values so the kernel adds them exactly
-        # like the dense `dynamic + static` chain.
-        srows = {}
-        for local in range(rows):
-            p = int(rep_priv[c0 + local])
-            if p >= 0 and p in score_rows_map:
-                srow = np.asarray(score_rows_map[p], np.float32)
-                score[local] += srow
-                srows[local] = srow
-
-        # Integer selection keys: quantized score in the high bits, the
-        # class/node hash in the low bits — kernels.bid_keys' exact
-        # format (shared constants), so selection ordering tracks bid
-        # ordering if the key layout is ever retuned.
-        q = np.clip(
-            np.round(score / np.float32(SCORE_QUANTUM)).astype(np.int64)
-            + _KEY_BIAS,
-            0, (1 << 20) - 1,
-        )
-        skey = (q << _KEY_HASH_BITS) | _sel_hash(
-            np.arange(c0, c1, dtype=np.int64)[:, None],
-            node_ids[None, :],
-        )
-        skey = np.where(elig, skey, -1)
 
         if k < N:
             part = np.argpartition(skey, N - k, axis=1)[:, N - k:]
@@ -287,6 +489,11 @@ def select_candidates(
             sel = row < N
             cand_static[c0 + local, sel] = srow[row[sel]]
 
+    if sc is not None and not has_releasing:
+        sc.rows = {
+            key: row for key, row in new_rows.items() if row is not None
+        }
+
     slab_bytes = (
         cand_idx.nbytes + cand_static.nbytes + cand_info.nbytes
         + task_cand.nbytes
@@ -300,5 +507,8 @@ def select_candidates(
         "dense_mask_bytes": int(T) * int(N),
         "dense_score_bytes": int(T) * int(N) * 4,
         "truncated_classes": int((cand_info[0] > k).sum()),
+        # Cross-cycle selection-cache effectiveness (classes whose key
+        # rows were reused with only churned columns recomputed).
+        "sel_cache_hits": int(cache_hits),
     }
     return CandidateSet(task_cand, cand_idx, cand_static, cand_info, stats)

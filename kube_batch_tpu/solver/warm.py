@@ -1,0 +1,279 @@
+"""Warm-started steady cycles: carry the previous solve's verdicts.
+
+A periodic cycle at steady state re-derives a conclusion it already
+reached one period ago: every pending task it re-solves was left
+unassigned by the previous cycle, against capacities that have only
+SHRUNK since (the scheduler's own placements), budgets that have only
+tightened, and feasibility that has not moved. CvxCluster (PAPERS.md)
+gets its 100-1000x on granular allocation problems from exactly this
+solution-reuse structure. This module is the state machine that decides,
+per cycle, how much of the previous solve survives:
+
+``noop``
+    No job gained schedulable work since the previous solve and every
+    delta precondition holds — the previous cycle's verdicts ARE this
+    cycle's verdicts, bit-for-bit, and the solve/selection/apply phases
+    are skipped entirely. Only the cache maintenance half of tensorize
+    runs (``tensorize(warm_noop=True)``: node-array + predicate-column
+    patching against the narrow ledger). Exactness argument: the solver
+    runs rounds to a fixed point, and the cluster state at this snapshot
+    IS the previous solve's fixed point (placements applied exactly the
+    deltas the solve committed; nothing else moved, per the
+    preconditions below) — re-running the rounds would accept nothing in
+    round one and stop.
+
+``solve``
+    New work arrived (dirty jobs with pending tasks) and NO unassigned
+    tasks were carried over — the problem contains exactly the new work,
+    solved against the residual capacities already resident in the
+    incremental tensorize / device caches. This is the steady
+    placement-wave regime: cycle cost scales with churn.
+
+fallback (full solve, labeled by reason)
+    Any delta precondition failure re-solves everything from the ground
+    truth — bit-parity with a cold scheduler is the invariant the
+    randomized churn tests pin. Reasons:
+
+    - ``cold`` / ``stale``: no warm state, or a snapshot generation gap
+      (some cycle's ledger drained without a warm save);
+    - ``node-dirty``: a third-party node event (death, watch update,
+      eviction) — capacities may have GROWN, carried verdicts void;
+    - ``releasing``: Releasing capacity exists — the pipeline epilogue
+      may place carried tasks, outside the fixed-point argument;
+    - ``carried-changed``: a carried job was mutated by anything other
+      than the scheduler's own binds (completion, preempt, partial-gang
+      revert), or its pending remainder drifted from the solve's;
+    - ``deserved-changed``: a carried job's queue budget (proportion's
+      water-filled deserved) moved — a previously budget-blocked task
+      might now pass;
+    - ``carried-interleave``: new work arrived WHILE unassigned tasks
+      are carried. The subset problem would order/tie-break differently
+      than the full problem (progressive-filling keys and bid-key
+      hashes are rank-dependent), so bit-parity forces the full solve;
+    - ``drift``: the warm-noop tensorize found node rows dirty beyond
+      the narrow ledger (a session-side mutation the plan could not
+      see) — the cycle re-runs as a full solve.
+
+The state lives on the SchedulerCache (``_warm_solve_state``), the same
+lifetime pattern as the tensorize/device caches. ``plan_warm`` is
+called by allocate_tpu before tensorize; ``save_warm_state`` after the
+apply/verdict phases of every solving cycle (and ``advance_noop`` after
+a no-op cycle).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..api import TaskStatus
+
+logger = logging.getLogger(__name__)
+
+
+class WarmSolveState:
+    """Carried verdicts of the most recent solve (see module doc)."""
+
+    __slots__ = (
+        "valid", "snap_gen", "carried", "queue_deserved", "has_releasing",
+    )
+
+    def __init__(self):
+        self.valid = False
+        self.snap_gen = -1
+        # job uid -> (job clone object, clone _ver at save, pending
+        # remainder at save). Identity+ver pins "untouched"; a
+        # narrow-dirty re-clone passes iff its pending count still
+        # equals the remainder (a bind-bookkeeping revert would grow
+        # it, and a reverted task must be re-solved).
+        self.carried: Dict[str, tuple] = {}
+        # queue uid -> deserved Resource clone (None when no budget
+        # plugin had an opinion) for every queue owning carried jobs.
+        self.queue_deserved: Dict[str, object] = {}
+        self.has_releasing = True  # conservative until first save
+
+
+def warm_state_of(cache) -> Optional[WarmSolveState]:
+    if cache is None:
+        return None
+    ws = getattr(cache, "_warm_solve_state", None)
+    if ws is None:
+        ws = WarmSolveState()
+        try:
+            cache._warm_solve_state = ws
+        except Exception:  # slots-only stand-in cache
+            return None
+    return ws
+
+
+def warm_enabled() -> bool:
+    return os.environ.get("KBT_WARM", "1") != "0"
+
+
+def _res_eq(a, b) -> bool:
+    """Exact Resource equality (Resource.__eq__); None-tolerant."""
+    if a is None or b is None:
+        return a is None and b is None
+    return a == b
+
+
+def _deserved_of(ssn, queue) -> Optional[object]:
+    """The queue's deserved budget (first plugin with an opinion wins —
+    the same resolution tensorize uses for its budget vectors)."""
+    for fn in ssn.queue_budget_fns.values():
+        budget = fn(queue)
+        if budget is not None:
+            return budget[0]
+    return None
+
+
+def plan_warm(ssn) -> Tuple[str, List]:
+    """Classify this cycle against the warm state. Returns
+    ``(outcome, live_jobs)``: outcome ``noop``/``solve`` when the warm
+    path engages, else the fallback reason; ``live_jobs`` is the set of
+    jobs with new schedulable work (empty for noop and for fallbacks,
+    where the full solve covers everything anyway)."""
+    if not warm_enabled():
+        return "disabled", []
+    ws = warm_state_of(ssn.cache)
+    if ws is None or not ws.valid:
+        return "cold", []
+    if getattr(ssn, "snap_gen", 0) != ws.snap_gen + 1:
+        return "stale", []
+    if ssn.dirty_nodes:
+        return "node-dirty", []
+    if ws.has_releasing:
+        return "releasing", []
+
+    pending_key = TaskStatus.PENDING
+    carried = ws.carried
+    live: List = []
+    seen = set()
+    for uid in ssn.dirty_jobs:
+        job = ssn.jobs.get(uid)
+        if job is not None and job.task_status_index.get(pending_key):
+            live.append(job)
+            seen.add(uid)
+
+    narrow = ssn.dirty_jobs_narrow
+    for uid, (obj, ver, remainder) in carried.items():
+        if uid in seen:
+            # Full-dirty carried job: its re-solve is part of the live
+            # set; the carried verdict is simply superseded.
+            continue
+        job = ssn.jobs.get(uid)
+        if job is None:
+            return "carried-changed", []
+        if job is obj and job._ver == ver:
+            continue
+        if (
+            uid in narrow
+            and len(job.task_status_index.get(pending_key) or ()) == remainder
+        ):
+            # Bind-only churn with the exact unassigned remainder left
+            # pending: the job is in precisely the state the previous
+            # solve ended in.
+            continue
+        return "carried-changed", []
+
+    # A narrow-dirty job that is NOT carried but has pending tasks means
+    # a bind-bookkeeping revert put an assigned task back — re-solve it.
+    for uid in narrow:
+        if uid in carried or uid in seen:
+            continue
+        job = ssn.jobs.get(uid)
+        if job is not None and job.task_status_index.get(pending_key):
+            live.append(job)
+            seen.add(uid)
+
+    if carried:
+        quids = {obj.queue for (obj, _v, _r) in carried.values()}
+        for quid in quids:
+            queue = ssn.queues.get(quid)
+            cur = _deserved_of(ssn, queue) if queue is not None else None
+            if not _res_eq(cur, ws.queue_deserved.get(quid)):
+                return "deserved-changed", []
+
+    if not live:
+        return "noop", []
+    if carried:
+        # Carried unassigned tasks would interleave with the new work:
+        # subset ordering/tie-breaking diverges from the full problem,
+        # so bit-parity demands the full solve.
+        return "carried-interleave", live
+    return "solve", live
+
+
+def advance_noop(ssn) -> None:
+    """A no-op cycle consumed one snapshot generation; keep continuity.
+    Carried entries that passed the plan via the NARROW remainder check
+    (a bind re-minted the job's clone) are re-pinned to the current
+    clone — otherwise the very next cycle's identity check would fail
+    against the drained ledger and force a spurious carried-changed
+    full solve after every partial placement wave."""
+    ws = warm_state_of(ssn.cache)
+    if ws is None:
+        return
+    ws.snap_gen = getattr(ssn, "snap_gen", 0)
+    for uid, (obj, ver, remainder) in list(ws.carried.items()):
+        job = ssn.jobs.get(uid)
+        if job is not None and (job is not obj or job._ver != ver):
+            ws.carried[uid] = (job, job._ver, remainder)
+
+
+def invalidate(cache) -> None:
+    ws = getattr(cache, "_warm_solve_state", None)
+    if ws is not None:
+        ws.valid = False
+
+
+def save_warm_state(ssn, ctx, assigned) -> int:
+    """Record this solve's carried verdicts (called post-apply). With
+    ``ctx is None`` (an idle cycle: nothing pending) the carried set is
+    empty — the strongest warm state there is. Returns the carried job
+    count (stats)."""
+    ws = warm_state_of(ssn.cache)
+    if ws is None:
+        return 0
+    carried: Dict[str, tuple] = {}
+    has_releasing = True
+    if ctx is None:
+        # Idle: no pending tasks at all. Releasing presence from the
+        # tensorize cache's freshly absorbed columns.
+        tc = getattr(ssn.cache, "_tensorize_cache", None)
+        if tc is not None and tc.releasing is not None and len(
+            getattr(tc, "node_objs", None) or ()
+        ) == len(ssn.nodes):
+            has_releasing = bool(tc.releasing.any())
+    else:
+        import numpy as np
+
+        has_releasing = bool(ctx.has_releasing)
+        T = len(ctx.tasks)
+        a = np.asarray(assigned[:T])
+        for i in np.nonzero(a < 0)[0].tolist():
+            task = ctx.tasks[i]
+            if task.job in carried:
+                continue
+            job = ssn.jobs.get(task.job)
+            if job is None:
+                continue
+            carried[task.job] = (
+                job, job._ver,
+                len(job.task_status_index.get(TaskStatus.PENDING) or ()),
+            )
+    deserved: Dict[str, object] = {}
+    for uid, (job, _v, _r) in carried.items():
+        quid = job.queue
+        if quid in deserved:
+            continue
+        queue = ssn.queues.get(quid)
+        d = _deserved_of(ssn, queue) if queue is not None else None
+        deserved[quid] = d.clone() if d is not None else None
+    ws.carried = carried
+    ws.queue_deserved = deserved
+    ws.has_releasing = has_releasing
+    ws.snap_gen = getattr(ssn, "snap_gen", 0)
+    ws.valid = True
+    return len(carried)

@@ -1,0 +1,638 @@
+"""Warm-started steady cycles: bit parity with a cold scheduler.
+
+The warm-start state machine (solver/warm.py) skips or shrinks the
+solve when its delta preconditions prove the previous cycle's verdicts
+still hold. The contract pinned here: a scheduler running with the warm
+path ENABLED must leave bit-identical cluster state — per-task
+placements and per-node idle accounting — to a scheduler running every
+cycle cold (KBT_WARM=0), across randomized placement-wave, arrival,
+completion, node-death and eviction sequences. Fallback cycles count as
+parity too: the machine's job is to never be wrong, not to always
+engage.
+
+Also here: the narrow dirty ledger's semantics (bind bookkeeping
+stamps narrow, third-party events win), warm-noop engagement stats,
+micro-cycle behavior (placement through the warm path only, deferral
+otherwise, flight-record cycle_kind), the incremental-snapshot parity
+against the forced full walk, and the zero-new-jits warm-path retrace
+guard.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import kube_batch_tpu.actions  # noqa: F401 (registers actions)
+import kube_batch_tpu.plugins  # noqa: F401 (registers plugins)
+from kube_batch_tpu.api import PodPhase, TaskStatus, build_resource_list
+from kube_batch_tpu.framework import close_session, get_action, open_session
+from kube_batch_tpu.actions.allocate_tpu import last_stats
+from kube_batch_tpu.utils.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+from tests.actions.test_actions import DEFAULT_TIERS_ARGS, make_cache, make_tiers
+
+
+def _env(key, value):
+    """Set/unset an env var, returning the previous value."""
+    prev = os.environ.get(key)
+    if value is None:
+        os.environ.pop(key, None)
+    else:
+        os.environ[key] = value
+    return prev
+
+
+class _ScenarioDriver:
+    """Replays one seeded event script against a fresh cache+action."""
+
+    def __init__(self, seed, nodes=8, queues=2):
+        self.rng = np.random.RandomState(seed)
+        self.nodes = nodes
+        self.queues = queues
+
+    def script(self, kinds, cycles):
+        """Generate a deterministic per-cycle event list: each entry is
+        (kind, payload) applied through the cache watch entry points."""
+        rng = self.rng
+        script = []
+        gang_n = [0]
+        for cycle in range(cycles):
+            events = []
+            for kind in kinds:
+                if kind == "arrival" and rng.rand() < 0.8:
+                    g = gang_n[0]
+                    gang_n[0] += 1
+                    size = int(rng.randint(1, 6))
+                    events.append(("gang", (f"g{g}", size, int(rng.randint(
+                        1, size + 1)), f"q{int(rng.randint(0, self.queues))}",
+                        int(rng.choice([250, 500, 1000, 2000])),
+                        int(rng.choice([256, 512, 1024])))))
+                elif kind == "wave" and cycle == 0:
+                    for g in range(6):
+                        gg = gang_n[0]
+                        gang_n[0] += 1
+                        events.append(("gang", (f"g{gg}", 6, 2,
+                                       f"q{gg % self.queues}", 500, 512)))
+                elif kind == "completion" and cycle >= 2 and rng.rand() < 0.5:
+                    events.append(("complete", int(rng.randint(0, 1 << 30))))
+                elif kind == "node-death" and cycle == cycles // 2:
+                    events.append(("kill-node", int(rng.randint(0, self.nodes))))
+                elif kind == "evict" and cycle >= 2 and rng.rand() < 0.4:
+                    events.append(("evict", int(rng.randint(0, 1 << 30))))
+            script.append(events)
+        return script
+
+    def run(self, script, warm: bool):
+        prev = _env("KBT_WARM", None if warm else "0")
+        try:
+            cache = make_cache()
+            for q in range(self.queues):
+                cache.add_queue(build_queue(f"q{q}", weight=q + 1))
+            for j in range(self.nodes):
+                cache.add_node(build_node(
+                    f"n{j}",
+                    build_resource_list(cpu="8", memory="32Gi", pods=110),
+                ))
+            action, _ = get_action("allocate_tpu")
+            tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+            states = []
+            outcomes = []
+            for events in script:
+                self._apply(cache, events)
+                ssn = open_session(cache, tiers)
+                action.execute(ssn)
+                outcomes.append(last_stats.get("warm_outcome"))
+                close_session(ssn)
+                assert cache.wait_for_side_effects(timeout=30.0)
+                assert cache.wait_for_bookkeeping(timeout=30.0)
+                states.append(self._state(cache))
+            cache.shutdown()
+            return states, outcomes
+        finally:
+            _env("KBT_WARM", prev)
+
+    def _apply(self, cache, events):
+        for kind, payload in events:
+            if kind == "gang":
+                name, size, min_member, queue, cpu, mem = payload
+                cache.add_pod_group(build_pod_group(
+                    name, namespace="ns", min_member=min_member, queue=queue,
+                ))
+                for i in range(size):
+                    cache.add_pod(build_pod(
+                        "ns", f"{name}-p{i}", "", PodPhase.PENDING,
+                        build_resource_list(
+                            cpu=f"{cpu}m", memory=f"{mem}Mi"
+                        ),
+                        group_name=name,
+                    ))
+            elif kind == "complete":
+                bound = self._bound_tasks(cache)
+                if bound:
+                    task = bound[payload % len(bound)]
+                    pod = task.pod
+                    pod.status.phase = PodPhase.SUCCEEDED
+                    cache.delete_pod(pod)
+            elif kind == "kill-node":
+                name = f"n{payload % self.nodes}"
+                node = cache.nodes.get(name)
+                if node is not None and node.node is not None:
+                    cache.delete_node(node.node)
+            elif kind == "evict":
+                bound = self._bound_tasks(cache)
+                if bound:
+                    task = bound[payload % len(bound)]
+                    try:
+                        cache.evict(task, "test-preempt")
+                    except Exception:
+                        pass
+
+    @staticmethod
+    def _bound_tasks(cache):
+        out = []
+        with cache.mutex:
+            for key in sorted(cache.jobs):
+                job = cache.jobs[key]
+                for uid in sorted(job.tasks):
+                    t = job.tasks[uid]
+                    if t.status == TaskStatus.BINDING and t.node_name:
+                        out.append(t)
+        return out
+
+    @staticmethod
+    def _state(cache):
+        """Settled mirror truth: placements + exact idle accounting."""
+        with cache.mutex:
+            jobs = {
+                key: sorted(
+                    (uid, t.status.name, t.node_name)
+                    for uid, t in job.tasks.items()
+                )
+                for key, job in cache.jobs.items()
+            }
+            nodes = {
+                name: (
+                    n.idle.milli_cpu, n.idle.memory,
+                    n.used.milli_cpu, n.used.memory,
+                    len(n.tasks),
+                )
+                for name, n in cache.nodes.items()
+            }
+        return jobs, nodes
+
+
+SCENARIOS = {
+    "placement-wave": (["wave", "arrival"], 8),
+    "arrival": (["arrival"], 10),
+    "completion": (["wave", "arrival", "completion"], 10),
+    "node-death": (["wave", "arrival", "node-death"], 8),
+    "preempt": (["wave", "arrival", "evict"], 10),
+}
+
+
+class TestWarmColdBitParity:
+    @pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+    def test_randomized_churn_parity(self, scenario):
+        kinds, cycles = SCENARIOS[scenario]
+        for seed in (3, 17):
+            driver = _ScenarioDriver(seed)
+            script = driver.script(kinds, cycles)
+            warm_states, warm_outcomes = _ScenarioDriver(seed).run(
+                script, warm=True
+            )
+            cold_states, cold_outcomes = _ScenarioDriver(seed).run(
+                script, warm=False
+            )
+            assert all(o == "disabled" for o in cold_outcomes)
+            for c, (w, k) in enumerate(zip(warm_states, cold_states)):
+                assert w == k, (
+                    f"{scenario} seed {seed}: warm/cold state diverged "
+                    f"at cycle {c} (warm outcome "
+                    f"{warm_outcomes[c]!r})"
+                )
+
+    def test_arrival_scenario_actually_engages_warm(self):
+        driver = _ScenarioDriver(5)
+        script = driver.script(["arrival"], 10)
+        _, outcomes = _ScenarioDriver(5).run(script, warm=True)
+        # First cycle is cold; after that the pure-arrival stream must
+        # ride the warm path (solve for new work, noop when a cycle's
+        # rand produced no gang).
+        assert set(outcomes[1:]) <= {"solve", "noop"}, outcomes
+        assert "solve" in outcomes[1:]
+
+    def test_disqualifying_events_fall_back_labeled(self):
+        driver = _ScenarioDriver(9)
+        script = driver.script(["wave", "arrival", "node-death"], 8)
+        _, outcomes = _ScenarioDriver(9).run(script, warm=True)
+        assert "node-dirty" in outcomes or "carried-changed" in outcomes, (
+            outcomes
+        )
+
+
+class TestNarrowLedger:
+    def _cluster(self):
+        cache = make_cache()
+        cache.add_queue(build_queue("q0", weight=1))
+        for j in range(4):
+            cache.add_node(build_node(
+                "nn%d" % j, build_resource_list(cpu="8", memory="32Gi"),
+            ))
+        cache.add_pod_group(build_pod_group(
+            "pg0", namespace="ns", min_member=1, queue="q0",
+        ))
+        for i in range(4):
+            cache.add_pod(build_pod(
+                "ns", f"pg0-p{i}", "", PodPhase.PENDING,
+                build_resource_list(cpu="500m", memory="512Mi"),
+                group_name="pg0",
+            ))
+        return cache
+
+    def test_bind_bookkeeping_stamps_narrow(self):
+        cache = self._cluster()
+        action, _ = get_action("allocate_tpu")
+        ssn = open_session(cache, make_tiers(*DEFAULT_TIERS_ARGS))
+        action.execute(ssn)
+        close_session(ssn)
+        assert cache.wait_for_side_effects(timeout=30.0)
+        assert cache.wait_for_bookkeeping(timeout=30.0)
+        snap = cache.snapshot()
+        # Placements landed through bind bookkeeping only: every dirty
+        # name is NARROW.
+        assert snap.dirty_nodes_narrow
+        assert not snap.dirty_nodes
+        assert snap.dirty_jobs_narrow == frozenset({"ns/pg0"})
+        assert not snap.dirty_jobs
+        cache.shutdown()
+
+    def test_allocated_status_flip_stamps_narrow(self):
+        """A kubelet/bind-confirmation pod MODIFIED (same pod, same
+        node, allocated→allocated status, same resreq) is a pure
+        confirmation of the scheduler's own placement: it must stamp
+        NARROW, or live clusters re-dirty every node one cycle after
+        each bind and the warm path can never engage."""
+        cache = self._cluster()
+        action, _ = get_action("allocate_tpu")
+        ssn = open_session(cache, make_tiers(*DEFAULT_TIERS_ARGS))
+        action.execute(ssn)
+        close_session(ssn)
+        assert cache.wait_for_side_effects(timeout=30.0)
+        assert cache.wait_for_bookkeeping(timeout=30.0)
+        cache.snapshot()  # drain the bind stamps
+        # Flip one bound pod to Running in place, as the kubelet would.
+        job = cache.jobs["ns/pg0"]
+        task = next(
+            t for t in job.tasks.values()
+            if t.status == TaskStatus.BINDING
+        )
+        old_pod = task.pod
+        new_pod = build_pod(
+            "ns", task.name, task.node_name, PodPhase.RUNNING,
+            build_resource_list(cpu="500m", memory="512Mi"),
+            group_name="pg0",
+        )
+        new_pod.metadata.uid = old_pod.metadata.uid
+        cache.update_pod(old_pod, new_pod)
+        snap = cache.snapshot()
+        assert task.node_name in snap.dirty_nodes_narrow
+        assert task.node_name not in snap.dirty_nodes
+        assert "ns/pg0" in snap.dirty_jobs_narrow
+        # A RESIZED pod (resreq changed) is NOT a pure flip: full-dirty.
+        task2 = next(
+            t for t in cache.jobs["ns/pg0"].tasks.values()
+            if t.status == TaskStatus.RUNNING
+        )
+        bigger = build_pod(
+            "ns", task2.name, task2.node_name, PodPhase.RUNNING,
+            build_resource_list(cpu="1000m", memory="512Mi"),
+            group_name="pg0",
+        )
+        bigger.metadata.uid = task2.pod.metadata.uid
+        cache.update_pod(task2.pod, bigger)
+        snap = cache.snapshot()
+        assert task2.node_name in snap.dirty_nodes
+        assert task2.node_name not in snap.dirty_nodes_narrow
+        cache.shutdown()
+
+    def test_third_party_event_wins_over_narrow(self):
+        cache = self._cluster()
+        action, _ = get_action("allocate_tpu")
+        ssn = open_session(cache, make_tiers(*DEFAULT_TIERS_ARGS))
+        action.execute(ssn)
+        close_session(ssn)
+        assert cache.wait_for_side_effects(timeout=30.0)
+        assert cache.wait_for_bookkeeping(timeout=30.0)
+        # A watch update on a node that ALSO saw binds: full-dirty wins.
+        node = cache.nodes["nn0"]
+        cache.update_node(node.node, node.node)
+        snap = cache.snapshot()
+        assert "nn0" in snap.dirty_nodes
+        assert "nn0" not in snap.dirty_nodes_narrow
+        cache.shutdown()
+
+    def test_wave_cycle_is_noop_with_wave_patches(self):
+        cache = self._cluster()
+        action, _ = get_action("allocate_tpu")
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        for _ in range(2):
+            ssn = open_session(cache, tiers)
+            action.execute(ssn)
+            close_session(ssn)
+            assert cache.wait_for_side_effects(timeout=30.0)
+            assert cache.wait_for_bookkeeping(timeout=30.0)
+        # Second cycle absorbed the first cycle's placement wave as a
+        # warm no-op with allocation-only column patches.
+        assert last_stats["warm_outcome"] == "noop"
+        ts = {
+            k: v for k, v in last_stats.items() if k.startswith("tensorize")
+        }
+        assert ts.get("tensorize_incremental") is True
+        assert ts.get("tensorize_wave_patched", 0) > 0
+        assert ts.get("tensorize_wave_patched") == ts.get(
+            "tensorize_dirty_nodes"
+        )
+        cache.shutdown()
+
+
+class TestCarriedRepin:
+    def test_partial_placement_noop_chain_stays_warm(self):
+        """A job with a placed head and an unplaceable tail: the wave
+        re-mints its clone (narrow), the absorb cycle passes via the
+        remainder check, and advance_noop RE-PINS the carried entry —
+        the following cycles must stay noop instead of paying one
+        spurious carried-changed full solve per placement wave."""
+        cache = make_cache()
+        cache.add_queue(build_queue("q0", weight=1))
+        for j in range(2):
+            cache.add_node(build_node(
+                f"n{j}", build_resource_list(cpu="4", memory="16Gi"),
+            ))
+        cache.add_pod_group(build_pod_group(
+            "mix", namespace="ns", min_member=1, queue="q0",
+        ))
+        # Two placeable heads + one tail that fits NO node; names order
+        # the tail last under the uid tiebreak so the job-break gates
+        # only the tail.
+        for i in range(2):
+            cache.add_pod(build_pod(
+                "ns", f"mix-a{i}", "", PodPhase.PENDING,
+                build_resource_list(cpu="500m", memory="512Mi"),
+                group_name="mix",
+            ))
+        cache.add_pod(build_pod(
+            "ns", "mix-z-huge", "", PodPhase.PENDING,
+            build_resource_list(cpu="64", memory="512Gi"),
+            group_name="mix",
+        ))
+        action, _ = get_action("allocate_tpu")
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        outcomes = []
+        placed = []
+        for _ in range(5):
+            ssn = open_session(cache, tiers)
+            action.execute(ssn)
+            outcomes.append(last_stats.get("warm_outcome"))
+            placed.append(last_stats.get("placed", 0))
+            close_session(ssn)
+            assert cache.wait_for_side_effects(timeout=30.0)
+            assert cache.wait_for_bookkeeping(timeout=30.0)
+        cache.shutdown()
+        assert placed[0] == 2, (placed, outcomes)
+        # Cycle 1 absorbs the wave (noop via the narrow remainder
+        # check); every later cycle must stay noop — no spurious
+        # carried-changed re-solve of the unchanged problem.
+        assert outcomes[1:] == ["noop"] * 4, outcomes
+
+
+class TestMicroCycles:
+    def _sched(self, cache):
+        from kube_batch_tpu.scheduler import Scheduler
+
+        conf = (
+            'actions: "allocate_tpu"\n'
+            "tiers:\n"
+            "- plugins:\n"
+            "  - name: priority\n"
+            "  - name: gang\n"
+            "  - name: conformance\n"
+            "- plugins:\n"
+            "  - name: drf\n"
+            "  - name: predicates\n"
+            "  - name: proportion\n"
+            "  - name: nodeorder\n"
+        )
+        return Scheduler(cache, scheduler_conf=conf)
+
+    def test_micro_places_arrivals_through_warm_path(self):
+        cache = TestNarrowLedger._cluster(TestNarrowLedger())
+        sched = self._sched(cache)
+        sched.run_once()
+        assert cache.wait_for_side_effects(timeout=30.0)
+        assert cache.wait_for_bookkeeping(timeout=30.0)
+        cache.add_pod_group(build_pod_group(
+            "pgm", namespace="ns", min_member=2, queue="q0",
+        ))
+        for i in range(3):
+            cache.add_pod(build_pod(
+                "ns", f"pgm-p{i}", "", PodPhase.PENDING,
+                build_resource_list(cpu="250m", memory="256Mi"),
+                group_name="pgm",
+            ))
+        from kube_batch_tpu.obs import RECORDER
+
+        assert sched.run_micro()
+        assert last_stats.get("warm_outcome") == "solve"
+        assert last_stats.get("placed") == 3
+        rec = RECORDER.snapshot()[-1]
+        assert rec["cycle_kind"] == "micro"
+        assert cache.wait_for_side_effects(timeout=30.0)
+        cache.shutdown()
+
+    def test_micro_defers_when_warm_cannot_engage(self):
+        cache = TestNarrowLedger._cluster(TestNarrowLedger())
+        sched = self._sched(cache)
+        sched.run_once()
+        assert cache.wait_for_side_effects(timeout=30.0)
+        assert cache.wait_for_bookkeeping(timeout=30.0)
+        # Third-party node churn voids the warm plan: the micro cycle
+        # must place NOTHING and leave the work to the periodic cycle.
+        node = cache.nodes["nn1"]
+        cache.update_node(node.node, node.node)
+        cache.add_pod_group(build_pod_group(
+            "pgd", namespace="ns", min_member=1, queue="q0",
+        ))
+        cache.add_pod(build_pod(
+            "ns", "pgd-p0", "", PodPhase.PENDING,
+            build_resource_list(cpu="250m", memory="256Mi"),
+            group_name="pgd",
+        ))
+        assert sched.run_micro()
+        assert last_stats.get("micro_deferred") == "node-dirty"
+        assert "placed" not in last_stats
+        # The following periodic cycle picks the pod up.
+        sched.run_once()
+        assert last_stats.get("placed") == 1
+        assert cache.wait_for_side_effects(timeout=30.0)
+        cache.shutdown()
+
+    def test_arrival_listener_fires_on_pending_pod(self):
+        cache = TestNarrowLedger._cluster(TestNarrowLedger())
+        fired = []
+        cache.set_arrival_listener(lambda: fired.append(1))
+        cache.add_pod(build_pod(
+            "ns", "px", "", PodPhase.PENDING,
+            build_resource_list(cpu="100m", memory="64Mi"),
+        ))
+        assert fired
+        # A bound pod (not schedulable work) does not wake the loop.
+        fired.clear()
+        cache.add_pod(build_pod(
+            "ns", "py", "nn0", PodPhase.RUNNING,
+            build_resource_list(cpu="100m", memory="64Mi"),
+        ))
+        assert not fired
+        cache.shutdown()
+
+
+class TestIncrementalSnapshotParity:
+    def test_randomized_churn_matches_full_walk(self):
+        rng = np.random.RandomState(7)
+        driver = _ScenarioDriver(7)
+        script = driver.script(
+            ["wave", "arrival", "completion", "evict"], 8
+        )
+        cache = make_cache()
+        for q in range(2):
+            cache.add_queue(build_queue(f"q{q}", weight=q + 1))
+        for j in range(6):
+            cache.add_node(build_node(
+                f"n{j}", build_resource_list(cpu="8", memory="32Gi"),
+            ))
+        action, _ = get_action("allocate_tpu")
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        d = _ScenarioDriver(7)
+        d.nodes = 6
+        for events in script:
+            d._apply(cache, events)
+            # Incremental snapshot vs forced full walk on the SAME
+            # mirror state: keys, order, and object identity must agree
+            # (identity: both must reuse the same pool clones).
+            snap_inc = cache.snapshot()
+            prev = _env("KBT_SNAPSHOT_INCREMENTAL", "0")
+            snap_full = cache.snapshot()
+            _env("KBT_SNAPSHOT_INCREMENTAL", prev)
+            assert list(snap_inc.nodes) == list(snap_full.nodes)
+            assert list(snap_inc.jobs) == list(snap_full.jobs)
+            for k in snap_inc.nodes:
+                assert snap_inc.nodes[k] is snap_full.nodes[k]
+            for k in snap_inc.jobs:
+                assert snap_inc.jobs[k] is snap_full.jobs[k]
+            t_inc = snap_inc.total_allocatable
+            t_full = snap_full.total_allocatable
+            assert abs(t_inc.milli_cpu - t_full.milli_cpu) < 1e-6
+            assert abs(t_inc.memory - t_full.memory) < 1.0
+            ssn = open_session(cache, tiers)
+            action.execute(ssn)
+            close_session(ssn)
+            assert cache.wait_for_side_effects(timeout=30.0)
+            assert cache.wait_for_bookkeeping(timeout=30.0)
+        cache.shutdown()
+
+    def test_direct_mirror_poke_is_caught(self):
+        """A test (or rogue caller) replacing a mirror object without
+        any ledger stamp must still invalidate its snapshot entry —
+        the verification arrays, not the ledger, are the truth."""
+        cache = make_cache()
+        cache.add_queue(build_queue("q0", weight=1))
+        cache.add_node(build_node(
+            "n0", build_resource_list(cpu="8", memory="32Gi"),
+        ))
+        snap1 = cache.snapshot()
+        # In-place mutation through a mutator (bumps _ver, no stamp).
+        from kube_batch_tpu.api import TaskInfo
+
+        pod = build_pod(
+            "ns", "poke", "n0", PodPhase.RUNNING,
+            build_resource_list(cpu="1", memory="1Gi"),
+        )
+        with cache.mutex:
+            cache.nodes["n0"].add_task(TaskInfo(pod))
+        snap2 = cache.snapshot()
+        assert snap2.nodes["n0"] is not snap1.nodes["n0"]
+        assert snap2.nodes["n0"].idle.milli_cpu == (
+            snap1.nodes["n0"].idle.milli_cpu - 1000.0
+        )
+        cache.shutdown()
+
+
+class TestWarmRetraceGuard:
+    def test_zero_new_jits_on_warm_path(self):
+        """Steady warm cycles on the jax backend must not mint solver
+        or patch jit variants after the first warm round's shapes are
+        compiled (the warm problem reuses the same buckets)."""
+        prev = _env("KBT_SOLVER", "jax")
+        try:
+            from kube_batch_tpu.solver import jit_compilation_count
+
+            cache = make_cache()
+            cache.add_queue(build_queue("q0", weight=1))
+            for j in range(4):
+                cache.add_node(build_node(
+                    f"n{j}", build_resource_list(cpu="64", memory="256Gi"),
+                ))
+            action, _ = get_action("allocate_tpu")
+            tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+
+            def burst(r):
+                cache.add_pod_group(build_pod_group(
+                    f"w{r}", namespace="ns", min_member=1, queue="q0",
+                ))
+                for i in range(3):
+                    cache.add_pod(build_pod(
+                        "ns", f"w{r}-p{i}", "", PodPhase.PENDING,
+                        build_resource_list(cpu="250m", memory="256Mi"),
+                        group_name=f"w{r}",
+                    ))
+
+            def cycle():
+                ssn = open_session(cache, tiers)
+                action.execute(ssn)
+                close_session(ssn)
+                assert cache.wait_for_side_effects(timeout=30.0)
+                assert cache.wait_for_bookkeeping(timeout=30.0)
+
+            # Warm-up: two burst rounds compile every shape bucket the
+            # steady stream will use.
+            for r in range(2):
+                burst(r)
+                cycle()
+            baseline = jit_compilation_count()
+            for r in range(2, 6):
+                burst(r)
+                cycle()
+                assert last_stats.get("warm_outcome") in ("solve", "noop")
+            assert jit_compilation_count() == baseline
+        finally:
+            _env("KBT_SOLVER", prev)
+            cache.shutdown()
+
+
+class TestMicroSimInvariants:
+    def test_micro_sim_run_is_invariant_clean(self):
+        from kube_batch_tpu.sim.harness import SimConfig, run_sim
+
+        report, _trace = run_sim(SimConfig(
+            cycles=120, seed=13, backend="native", micro_every=3,
+            faults="bind:0.05",
+        ))
+        assert report.cycles == 120
+        assert report.violations == []
+        assert report.cycle_errors == 0
+        assert report.placements > 0

@@ -188,7 +188,11 @@ def tensorize_arrays(ssn):
 
 
 def drop_cycle_caches(cache):
-    for attr in ("_tensorize_cache", "_pred_batch_cache"):
+    # Every cross-cycle tensorize-side cache, including the selection
+    # key-row cache (solver/topk) — the incremental-vs-full comparison
+    # below then pins cached selection against a cold one too.
+    for attr in ("_tensorize_cache", "_pred_batch_cache",
+                 "_topk_sel_cache"):
         if hasattr(cache, attr):
             delattr(cache, attr)
 

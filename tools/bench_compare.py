@@ -65,6 +65,17 @@ POLICY: List[Tuple[str, str, float, str]] = [
     ("cycle.steady.cycle_ms", "lower", 0.15, "single"),
     ("cycle.idle.cycle_ms", "lower", 0.15, "single"),
     ("cycle.delta.cycle_ms", "lower", 0.15, "single"),
+    # The breaker-pinned native-floor burst (PR 7) — comparable since
+    # both sides of the window carry it (r07+).
+    ("cycle.degraded.cycle_ms", "lower", 0.15, "single"),
+    # Warm-started steady cycles (PR 8): the 1%-churn steady state is a
+    # median over 5 rounds (med kind → tight threshold is defensible);
+    # the micro-cycle arrival-to-placement points are single-shot.
+    ("cycle.steady_warm.cycle_ms", "lower", 0.15, "med"),
+    ("cycle.micro_cycle.burst_0p1.arrival_to_placement_ms",
+     "lower", 0.25, "single"),
+    ("cycle.micro_cycle.burst_1p.arrival_to_placement_ms",
+     "lower", 0.25, "single"),
     # Percentages/ratios are machine-independent: kind "ratio" keeps
     # them OUT of the canary normalization.
     ("obs.tracer_overhead_pct", "lower", 10.0, "ratio"),
