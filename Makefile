@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke bench-compare verify ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke bench-compare verify kbtlint typecheck ci image clean
 
 all: native
 
@@ -77,9 +77,13 @@ soak-smoke:
 # default-route — absorbs the injected solver exceptions/hangs). The
 # CLI exits 1 on any invariant violation and 3 on any cycle error
 # (--fail-on-cycle-errors): a wedge or an uncontained device fault
-# fails the build. doc/design/robustness.md.
+# fails the build. doc/design/robustness.md. KBT_LOCK_DEBUG=1 arms the
+# order-asserting lock proxies (utils/lockdebug.py) — a lock-order
+# violation anywhere in the storm raises with both acquisition
+# tracebacks and fails the cycle (doc/design/static-analysis.md).
 chaos-smoke:
-	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim --cycles 250 --seed 11 \
+	env $(CPU_ENV) KBT_LOCK_DEBUG=1 $(PY) -m kube_batch_tpu sim \
+		--cycles 250 --seed 11 \
 		--backend dense \
 		--faults "solver-exc:0.08,solver-hang:0.02,bind:0.05" \
 		--fail-on-cycle-errors --quiet
@@ -91,7 +95,8 @@ chaos-smoke:
 # solver faults on the micro path too, and the invariant checker runs
 # every cycle — exit 1 on any violation, 3 on any cycle error.
 micro-smoke:
-	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim --cycles 250 --seed 11 \
+	env $(CPU_ENV) KBT_LOCK_DEBUG=1 $(PY) -m kube_batch_tpu sim \
+		--cycles 250 --seed 11 \
 		--backend dense --micro-every 4 \
 		--faults "solver-exc:0.08,solver-hang:0.02,bind:0.05" \
 		--fail-on-cycle-errors --quiet
@@ -113,15 +118,33 @@ bench-compare:
 
 # Static checks (reference verify: gofmt/goimports/golint,
 # Makefile:13-17): byte-compile + the AST lint (unused/duplicate
-# imports, star imports, syntax) + the metrics census drift guard
-# (doc/design/metrics.md must match metrics.REGISTRY exactly, both
-# directions — it also runs with the full suite, but verify fails it
-# fast and first in `make ci`).
+# imports, star imports, syntax). The metrics census that used to run
+# here as a standalone pytest moved into the unified kbtlint census
+# pass (next target) — the runtime twin test still runs in `make test`.
 verify:
 	$(PY) -m compileall -q kube_batch_tpu tests bench.py __graft_entry__.py
 	$(PY) tools/lint.py
-	env $(CPU_ENV) $(PY) -m pytest tests/unit/test_metrics_census.py -q \
-		-p no:cacheprovider
+
+# Project-invariant static analysis (doc/design/static-analysis.md):
+# lock-order graph (cycles, fence-leaf rule, blocking work under
+# cache.mutex), dirty-ledger completeness, jit hygiene, and the
+# doc<->code censuses (metrics / KBT_* env vars / flight-record keys /
+# /debug/vars keys — exact, both directions). Findings fail the build
+# unless allowlisted WITH a reason (tools/kbtlint/allowlist.json;
+# stale entries fail too). Then the self-test: a seeded violation of
+# every pass must flip the exit code — a checker that cannot see a
+# violation is decoration.
+kbtlint:
+	$(PY) -m tools.kbtlint
+	$(PY) -m tools.kbtlint --self-test
+
+# Strict-mode type-check baseline over solver/ + cache/ with a
+# committed suppression ledger (tools/typecheck_baseline.json, ratchet
+# semantics). Uses mypy --strict when installed; this image has none,
+# so the stdlib annotation audit holds the line (the ledger records
+# which tool banked it). doc/design/static-analysis.md.
+typecheck:
+	$(PY) tools/typecheck.py
 
 # The exact CI pipeline (.github/workflows/ci.yml), runnable locally:
 # verify -> native -> test -> perf smoke -> bench smoke
@@ -129,7 +152,7 @@ verify:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke bench-compare
+ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

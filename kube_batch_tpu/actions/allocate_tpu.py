@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 import time
 
 import numpy as np
@@ -35,6 +34,7 @@ from ..framework import Action, register_action
 from ..obs import RECORDER, span
 from ..obs.tracer import TRACER
 from ..solver import solve_sharded, tensorize
+from ..utils.lockdebug import wrap_lock
 from ..utils.scheduler_helper import prioritize_nodes, select_best_node
 
 logger = logging.getLogger(__name__)
@@ -102,7 +102,10 @@ class _AbandonableWorker:
     def __init__(self, name: str):
         self._name = name
         self._pool = None
-        self._lock = threading.Lock()
+        # Per-instance identity: the native-solve and device-sync
+        # workers are distinct locks and must not alias in the
+        # KBT_LOCK_DEBUG order harness.
+        self._lock = wrap_lock(f"action.worker.{name}")
 
     def submit(self, fn):
         with self._lock:

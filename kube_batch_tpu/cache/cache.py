@@ -42,6 +42,7 @@ from ..api import (
 from ..obs.tracer import TRACER, span as _obs_span
 from ..api.objects import DEFAULT_SCHEDULER_NAME
 from ..cluster import ADDED, DELETED, MODIFIED, ClusterAPI
+from ..utils.lockdebug import wrap_lock
 from .event_handlers import EventHandlersMixin
 from .interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
 from .util import job_terminated, shadow_pod_group
@@ -159,7 +160,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
         volume_binder: Optional[VolumeBinder] = None,
         enable_priority_class: bool = True,
     ):
-        self.mutex = threading.RLock()
+        # Named for the KBT_LOCK_DEBUG order-asserting harness (raw
+        # locks when the flag is off — wrap_lock is identity then).
+        self.mutex = wrap_lock("cache.mutex", threading.RLock())
         self.cluster = cluster
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
@@ -264,7 +267,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
         )
         self._inflight = 0
         self._bookkeeping_inflight = 0
-        self._inflight_cond = threading.Condition()
+        self._inflight_cond = threading.Condition(
+            wrap_lock("cache.inflight_cond", threading.RLock())
+        )
         self._synced = cluster is None
         self._stop = threading.Event()
         # Leadership fence (None = unfenced). Set by the loop watchdog /
@@ -276,7 +281,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
         # cycle may be deadlocked HOLDING the mutex, and the fencing
         # path must not join that deadlock.
         self._fence_reason: Optional[str] = None
-        self._fence_lock = threading.Lock()
+        # LEAF lock (lockdebug.LEAF_LOCKS + the kbtlint leaf rule):
+        # nothing may be acquired while it is held.
+        self._fence_lock = wrap_lock("cache.fence_lock")
         self._fence_refusals = 0
 
     # -- leadership fencing ---------------------------------------------------

@@ -78,8 +78,14 @@ class EventHandlersMixin:
                 job.set_pod_group(pg)
                 job.queue = self.default_queue
                 self.jobs[ti.job] = job
+                # New mirror entry: ledger-stamped HERE, not only by the
+                # _add_task caller — kbtlint's dirty-ledger pass holds
+                # every mutating function to "stamp reachable in the
+                # same function" (stamps are idempotent set-adds).
+                self._stamp_dirty(ti.job)
         elif ti.job not in self.jobs:
             self.jobs[ti.job] = JobInfo(ti.job)
+            self._stamp_dirty(ti.job)
         return self.jobs[ti.job]
 
     def _effective_job_key(self, ti: TaskInfo) -> str:
@@ -363,6 +369,12 @@ class EventHandlersMixin:
             job = self.jobs.get(job_key)
             if job is None:
                 return
+            # Found by kbtlint's dirty-ledger pass: every sibling
+            # handler stamps, but this one dropped the gang spec with
+            # no stamp — the delta-aware tensorize would keep serving
+            # the job's old min-available verdicts (PR 8 staleness
+            # class).
+            self._stamp_dirty(job_key)
             job.unset_pdb()
             # The cleanup loop re-checks job_terminated before removal, so
             # queueing unconditionally matches the reference's deleteJob

@@ -21,6 +21,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..utils.lockdebug import wrap_lock
 from ..api import (
     Node,
     Pod,
@@ -117,7 +118,7 @@ class InProcessCluster(ClusterAPI):
         second MODIFIED event) instead of instantly — gives the perf
         harness a measurable scheduled→running phase like kubemark's
         hollow kubelets."""
-        self._lock = threading.RLock()
+        self._lock = wrap_lock("cluster.store", threading.RLock())
         self._objects: Dict[str, Dict[str, object]] = {k: {} for k in self.KINDS}
         self._watchers: List[WatchHandler] = []
         self.simulate_kubelet = simulate_kubelet

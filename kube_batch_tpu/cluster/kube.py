@@ -42,6 +42,7 @@ import yaml
 
 from ..api import Pod, PodCondition, PodGroup
 from ..api.objects import SCHEDULING_GROUP
+from ..utils.lockdebug import wrap_lock
 from .api import ADDED, DELETED, MODIFIED, ClusterAPI, WatchHandler
 
 logger = logging.getLogger(__name__)
@@ -403,7 +404,7 @@ class KubeCluster(ClusterAPI):
         # RLock: the volume seam re-enters (assume_pod_volumes holds the
         # claims condition — which shares this lock — while the phase
         # lookup and _track need it too).
-        self._lock = threading.RLock()
+        self._lock = wrap_lock("cluster.kube", threading.RLock())
         # (namespace, name) -> ((holder, renewTime), local monotonic ts):
         # locally-observed lease transitions for skew-safe expiry.
         self._lease_observations: Dict = {}

@@ -9,15 +9,15 @@ here ``kube_batch_tpu.plugins``/``.actions`` package import does the same).
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, Optional, Tuple
 
+from ..utils.lockdebug import wrap_lock
 from .arguments import Arguments
 from .interface import Action, Plugin
 
 PluginBuilder = Callable[[Arguments], Plugin]
 
-_lock = threading.Lock()
+_lock = wrap_lock("framework.registry")
 _plugin_builders: Dict[str, PluginBuilder] = {}
 _actions: Dict[str, Action] = {}
 

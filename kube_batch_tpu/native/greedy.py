@@ -14,10 +14,11 @@ import ctypes
 import os
 import subprocess
 import tempfile
-import threading
 from typing import Optional, Tuple
 
 import numpy as np
+
+from ..utils.lockdebug import wrap_lock
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
 
@@ -29,7 +30,7 @@ def _build_dirs():
         tempfile.gettempdir(), f"tpu-batch-native-{os.getuid()}", "build"
     )
 
-_lock = threading.Lock()
+_lock = wrap_lock("native.loader")
 _lib: Optional[ctypes.CDLL] = None
 _load_error: Optional[str] = None
 

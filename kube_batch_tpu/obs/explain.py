@@ -36,6 +36,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..utils.lockdebug import wrap_lock
+
 REASON_PREDICATE = "predicate-blocked"
 REASON_QUEUE = "queue-overused"
 REASON_REFILL = "refill-exhausted"
@@ -83,7 +85,7 @@ class JobVerdict:
         }
 
 
-_lock = threading.Lock()
+_lock = wrap_lock("obs.explain")
 # job uid -> JobVerdict (the process-wide registry behind /debug/jobs
 # and the explain CLI).
 VERDICTS: Dict[str, JobVerdict] = {}

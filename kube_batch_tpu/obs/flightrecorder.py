@@ -32,6 +32,8 @@ import traceback
 from collections import deque
 from typing import List, Optional
 
+from ..utils.lockdebug import wrap_lock
+
 logger = logging.getLogger(__name__)
 
 DUMP_VERSION = 1
@@ -69,7 +71,7 @@ class FlightRecorder:
             )
         self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = wrap_lock("obs.flightrecorder")
         self._seq = 0
         self._open: Optional[dict] = None
         self.started_at = time.time()

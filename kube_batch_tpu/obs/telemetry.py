@@ -34,11 +34,12 @@ from __future__ import annotations
 import logging
 import math
 import os
-import threading
 import time
 import weakref
 from collections import deque
 from typing import Dict, List, Optional
+
+from ..utils.lockdebug import wrap_lock
 
 logger = logging.getLogger(__name__)
 
@@ -186,7 +187,7 @@ class Telemetry:
             max_windows = int(os.environ.get(
                 TELEMETRY_WINDOWS_ENV, DEFAULT_MAX_WINDOWS
             ))
-        self._lock = threading.Lock()
+        self._lock = wrap_lock("obs.telemetry")
         self._cache_ref = None          # weakref to the fed SchedulerCache
         self._fair_state: dict = {}     # fairness probe memo (node total)
         self.configure(window_cycles, max_windows, raw_capacity)

@@ -20,6 +20,7 @@ from .framework import Action, close_session, get_action, open_session
 from .obs import RECORDER, export_trace, span
 from .obs.tracer import TRACER, maybe_enable_from_env
 from .utils import deferred_gc
+from .utils.lockdebug import wrap_lock
 
 logger = logging.getLogger(__name__)
 
@@ -59,7 +60,7 @@ class LoopWatchdog:
         self.on_trip = on_trip
         self.trips = 0
         self.last_trip: Optional[dict] = None
-        self._lock = threading.Lock()
+        self._lock = wrap_lock("scheduler.watchdog")
         self._inflight_since: Optional[float] = None
         self._inflight_cycle: Optional[int] = None
         self._tripped_cycle: Optional[int] = None

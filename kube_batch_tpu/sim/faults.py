@@ -37,9 +37,10 @@ from __future__ import annotations
 
 import hashlib
 import random
-import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..utils.lockdebug import wrap_lock
 
 FAULT_KINDS = (
     "bind", "node-flap", "node-death", "evict", "solver", "crash",
@@ -123,7 +124,7 @@ class FaultInjector:
         self.spec = dict(spec or {})
         self.seed = seed
         self.rng = random.Random(f"{seed}/faults")
-        self._lock = threading.Lock()
+        self._lock = wrap_lock("sim.faults")
         self._bind_attempts: Dict[str, int] = {}
         self._cycle = -1
         self._active = False

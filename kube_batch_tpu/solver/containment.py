@@ -47,6 +47,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..utils.lockdebug import wrap_lock
+
 logger = logging.getLogger(__name__)
 
 
@@ -248,7 +250,7 @@ class CircuitBreaker:
         probe: Optional[Callable[[float], bool]] = None,
         probe_timeout: float = 5.0,
     ):
-        self._lock = threading.Lock()
+        self._lock = wrap_lock("solver.breaker")
         self.failure_threshold = int(
             os.environ.get("KBT_BREAKER_THRESHOLD", failure_threshold)
         )

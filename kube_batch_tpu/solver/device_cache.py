@@ -49,10 +49,11 @@ from __future__ import annotations
 
 import functools
 import logging
-import threading
 from typing import Dict, Optional
 
 import numpy as np
+
+from ..utils.lockdebug import wrap_lock
 
 logger = logging.getLogger(__name__)
 
@@ -92,7 +93,7 @@ _MIN_PATCH_BYTES = 4096
 
 # Row-bucket axes that have minted a patch jit (for retrace counting).
 _patch_axes_used: set = set()
-_patch_axes_lock = threading.Lock()
+_patch_axes_lock = wrap_lock("solver.patch_axes")
 
 
 def _row_bucket(n: int) -> int:

@@ -26,11 +26,12 @@ from __future__ import annotations
 import functools
 import logging
 import os
-import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..utils.lockdebug import wrap_lock
 
 from ..api import (
     JobInfo,
@@ -180,7 +181,7 @@ def _resource_matrix(resources, layout: ResourceLayout) -> np.ndarray:
 # width (1 disables).
 
 _rebuild_pool = None
-_rebuild_pool_lock = threading.Lock()
+_rebuild_pool_lock = wrap_lock("solver.rebuild_pool")
 # Below these sizes the submit/join overhead beats any overlap.
 _PAR_MIN_NODES = 1024
 _PAR_MIN_JOBS = 512

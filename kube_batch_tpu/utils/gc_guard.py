@@ -33,7 +33,9 @@ import gc
 import threading
 from contextlib import contextmanager
 
-_lock = threading.RLock()
+from .lockdebug import wrap_lock
+
+_lock = wrap_lock("utils.gc_guard", threading.RLock())
 _depth = 0
 _outer_was_enabled = False
 

@@ -1,0 +1,253 @@
+"""kbtlint (tools/kbtlint): fixture snippets per pass (known-bad →
+finding, known-good → clean), the allowlist roundtrip, the PR 7
+fence/mutex regression fixture, the censuses against the live tree,
+and the regression coverage for the bring-up fixes the passes surfaced
+(doc/design/static-analysis.md)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.kbtlint import census, core, dirty_ledger, jit_hygiene, lock_order
+from tools.kbtlint.selftest import run_selftest
+
+REPO = core.REPO
+FIXTURES = os.path.join(REPO, "tools", "kbtlint", "fixtures")
+
+
+def fixture_project(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return core.load_snippet(f.read(), rel=f"fixtures/{name}")
+
+
+# -- lock-order --------------------------------------------------------------
+
+
+class TestLockOrder:
+    def test_cycle_detected(self):
+        findings = lock_order.run(fixture_project("lock_cycle_bad.py"))
+        assert any("lock-order cycle" in f.message for f in findings)
+        # Both contributing edges are named.
+        assert sum("cycle" in f.message for f in findings) >= 2
+
+    def test_pr7_fence_mutex_shape(self):
+        """The regression fixture reproduces PR 7's deadlock through a
+        helper call — the pass must see it via the call graph, not just
+        textual nesting."""
+        findings = lock_order.run(fixture_project("fence_mutex_bad.py"))
+        assert any("leaf-lock violation" in f.message for f in findings)
+        assert any("_fence_lock" in f.message for f in findings)
+
+    def test_blocking_under_mutex(self):
+        findings = lock_order.run(fixture_project("mutex_blocking_bad.py"))
+        assert any("blocking call" in f.message for f in findings)
+        assert any("join()" in f.message for f in findings)
+
+    def test_known_good_clean(self):
+        assert lock_order.run(fixture_project("lock_good.py")) == []
+
+    def test_string_join_not_flagged(self):
+        project = core.load_snippet(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.mutex = threading.RLock()\n"
+            "    def fmt(self, parts):\n"
+            "        with self.mutex:\n"
+            "            return ', '.join(parts)\n"
+        )
+        assert lock_order.run(project) == []
+
+    def test_self_deadlock_on_plain_lock(self):
+        project = core.load_snippet(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.l = threading.Lock()\n"
+            "    def boom(self):\n"
+            "        with self.l:\n"
+            "            with self.l:\n"
+            "                pass\n"
+        )
+        findings = lock_order.run(project)
+        assert any("self-deadlock" in f.message for f in findings)
+
+    def test_real_tree_has_no_unallowlisted_findings(self):
+        project = core.load_project()
+        findings = lock_order.run(project)
+        entries = core.load_allowlist()
+        kept, _, _ = core.apply_allowlist(findings, entries)
+        assert kept == [], [f.render() for f in kept]
+
+
+# -- dirty-ledger ------------------------------------------------------------
+
+
+class TestDirtyLedger:
+    def test_unstamped_mutation_flagged(self):
+        findings = dirty_ledger.run(fixture_project("ledger_bad.py"))
+        assert any("unstamped allocation" in f.message for f in findings)
+
+    def test_transitive_stamp_accepted(self):
+        assert dirty_ledger.run(fixture_project("ledger_good.py")) == []
+
+    def test_cache_package_clean(self):
+        project = core.load_project()
+        findings = dirty_ledger.run(project)
+        entries = core.load_allowlist()
+        kept, _, _ = core.apply_allowlist(findings, entries)
+        assert kept == [], [f.render() for f in kept]
+
+
+# -- jit-hygiene -------------------------------------------------------------
+
+
+class TestJitHygiene:
+    def test_known_bad(self):
+        findings = jit_hygiene.run(fixture_project("jit_bad.py"))
+        messages = [f.message for f in findings]
+        assert any("branch on a traced value" in m for m in messages)
+        assert any("host sync" in m for m in messages)
+        assert any("donated-buffer reuse" in m for m in messages)
+
+    def test_known_good(self):
+        assert jit_hygiene.run(fixture_project("jit_good.py")) == []
+
+    def test_shape_branch_untainted(self):
+        project = core.load_snippet(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x.shape[0] > 2:\n"
+            "        return x\n"
+            "    return x * 2\n"
+        )
+        assert jit_hygiene.run(project) == []
+
+    def test_solver_package_clean(self):
+        project = core.load_project()
+        findings = jit_hygiene.run(project)
+        entries = core.load_allowlist()
+        kept, _, _ = core.apply_allowlist(findings, entries)
+        assert kept == [], [f.render() for f in kept]
+
+
+# -- allowlist ---------------------------------------------------------------
+
+
+class TestAllowlist:
+    def test_roundtrip(self, tmp_path):
+        path = tmp_path / "allow.json"
+        path.write_text(json.dumps([
+            {"pass": "lock-order", "file": "a.py", "match": "cycle",
+             "reason": "known false positive: ..."},
+        ]))
+        entries = core.load_allowlist(str(path))
+        finding = core.Finding("lock-order", "a.py", 1, "lock-order cycle: x")
+        kept, suppressed, stale = core.apply_allowlist([finding], entries)
+        assert kept == [] and len(suppressed) == 1 and stale == []
+
+    def test_stale_entry_reported(self):
+        entries = [core.AllowEntry("census", "x.md", "nope", "r")]
+        kept, suppressed, stale = core.apply_allowlist([], entries)
+        assert stale == entries
+
+    def test_reason_mandatory(self, tmp_path):
+        path = tmp_path / "allow.json"
+        path.write_text(json.dumps([
+            {"pass": "census", "file": "x.md", "match": "m", "reason": " "},
+        ]))
+        with pytest.raises(core.AllowlistError):
+            core.load_allowlist(str(path))
+
+    def test_committed_allowlist_loads(self):
+        core.load_allowlist()  # malformed JSON / missing reasons raise
+
+
+# -- census ------------------------------------------------------------------
+
+
+class TestCensus:
+    def test_tree_census_clean(self):
+        project = core.load_project()
+        findings = census.run(project)
+        entries = core.load_allowlist()
+        kept, _, _ = core.apply_allowlist(findings, entries)
+        assert kept == [], [f.render() for f in kept]
+
+    def test_env_table_nontrivial(self):
+        names, _ = census.read_marked_table(census.CONFIG_DOC, "env-vars")
+        assert names is not None and len(names) >= 15
+        assert "KBT_SOLVER_TOPK" in names
+        assert "KBT_LOCK_DEBUG" in names
+
+    def test_seeded_violation_detected(self):
+        names, line = census.read_marked_table(census.CONFIG_DOC, "env-vars")
+        seeded = census.compare_census(
+            "KBT env-var", names | {"KBT_NOT_DOCUMENTED"}, names,
+            census.CONFIG_DOC, line,
+        )
+        assert any("KBT_NOT_DOCUMENTED" in f.message for f in seeded)
+
+    def test_stale_doc_row_detected(self):
+        names, line = census.read_marked_table(census.CONFIG_DOC, "env-vars")
+        dropped = sorted(names)[0]
+        seeded = census.compare_census(
+            "KBT env-var", names - {dropped}, names,
+            census.CONFIG_DOC, line,
+        )
+        assert any("stale row" in f.message for f in seeded)
+
+    def test_registry_load_matches_runtime(self):
+        # The standalone metrics load must agree with the imported
+        # registry (the runtime twin in test_metrics_census.py).
+        from kube_batch_tpu import metrics
+
+        assert census._load_registry_names() == set(
+            metrics.REGISTRY.names()
+        )
+
+
+# -- driver / self-test ------------------------------------------------------
+
+
+class TestDriver:
+    def test_selftest_green(self):
+        assert run_selftest() == []
+
+    def test_cli_exit_codes(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.kbtlint"],
+            cwd=REPO, capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.kbtlint", "--self-test"],
+            cwd=REPO, capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- typecheck ratchet -------------------------------------------------------
+
+
+class TestTypecheckBaseline:
+    def test_in_baseline(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "typecheck.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_ledger_shape(self):
+        with open(os.path.join(REPO, "tools", "typecheck_baseline.json")) as f:
+            ledger = json.load(f)
+        assert ledger["tool"]
+        assert ledger["note"]
+        assert all(
+            isinstance(v, int) and v >= 0 for v in ledger["files"].values()
+        )

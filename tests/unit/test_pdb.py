@@ -99,6 +99,25 @@ class TestPdbHandlers:
         # queued for the cleanup loop (reference deleteJob path)
         assert not c.deleted_jobs.empty()
 
+    def test_delete_pdb_stamps_dirty_ledger(self):
+        """Regression for a kbtlint dirty-ledger bring-up finding:
+        delete_pdb dropped the job's gang spec with NO ledger stamp —
+        the delta-aware tensorize would keep serving the job's old
+        min-available verdicts (PR 8 staleness class). The stamp must
+        survive a fully-absorbed ledger, so drain AND absorb first."""
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pdb(make_pdb())
+        c.add_pod(owned_pod("p0"))
+        snap = c.snapshot()
+        assert "ctrl-1" in snap.dirty_jobs
+        # Simulate the tensorize refresh consuming the backlog — only
+        # a fresh stamp can re-dirty the name now.
+        c.note_full_absorbed(snap.dirty_jobs, snap.dirty_nodes)
+        c.delete_pdb(make_pdb())
+        snap2 = c.snapshot()
+        assert "ctrl-1" in snap2.dirty_jobs
+
     def test_ownerless_pdb_ignored(self):
         # Ordinary (label-selector) disruption budgets have no controller
         # owner and are not gang sources: skipped quietly, no job.

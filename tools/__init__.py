@@ -1,0 +1,2 @@
+# Package marker so `python -m tools.kbtlint` resolves from the repo
+# root (the driver itself locates the repo via __file__, not cwd).

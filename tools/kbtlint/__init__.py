@@ -1,0 +1,26 @@
+"""kbtlint: project-invariant static analysis for tpu-batch.
+
+The generic AST lint (tools/lint.py) catches language-level hygiene;
+this package checks *whole-program invariants of this codebase* that
+are otherwise enforced only by convention and after-the-fact tests
+(doc/design/static-analysis.md):
+
+- ``lock_order``    — lock-acquisition graph: order cycles, leaf-lock
+                      violations (the PR 7 fence/mutex deadlock class),
+                      blocking/device work while ``cache.mutex`` is held;
+- ``dirty_ledger``  — every mirror-side allocation mutation must stamp
+                      the dirty ledger (the PR 8 warm-path staleness
+                      class);
+- ``jit_hygiene``   — traced-value Python branching, host syncs, and
+                      donated-buffer reuse inside jit/shard_map code;
+- ``census``        — doc↔code drift guards: metrics registry,
+                      ``KBT_*`` env vars, flight-record keys,
+                      ``/debug/vars`` keys — exact, both directions.
+
+Findings are reported against ``tools/kbtlint/allowlist.json``; every
+suppression carries a mandatory reason (same policy as
+``tools/bench_allowlist.json``) and stale entries are themselves
+findings. Entry point: ``python -m tools.kbtlint`` (``make kbtlint``).
+"""
+
+from . import core  # noqa: F401  (re-export surface)

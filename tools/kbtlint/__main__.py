@@ -1,0 +1,101 @@
+"""kbtlint CLI driver (``make kbtlint``).
+
+Exit codes: 0 clean, 1 unallowlisted findings (or stale allowlist
+entries, or self-test failure), 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from . import core
+from .selftest import run_selftest
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kbtlint",
+        description="project-invariant static analysis for tpu-batch",
+    )
+    parser.add_argument(
+        "--pass", dest="passes", action="append", default=None,
+        help="run only this pass (repeatable); default: all",
+    )
+    parser.add_argument(
+        "--allow-file", default=core.ALLOWLIST_PATH,
+        help="allowlist JSON (default tools/kbtlint/allowlist.json)",
+    )
+    parser.add_argument(
+        "--no-allowlist", action="store_true",
+        help="report raw findings (bring-up mode)",
+    )
+    parser.add_argument(
+        "--self-test", action="store_true",
+        help="verify each pass flags its known-bad fixture and accepts "
+             "its known-good one",
+    )
+    parser.add_argument(
+        "--list-passes", action="store_true",
+    )
+    ns = parser.parse_args(argv)
+
+    if ns.self_test:
+        failures = run_selftest()
+        for failure in failures:
+            print(f"SELF-TEST FAIL: {failure}", file=sys.stderr)
+        if failures:
+            return 1
+        print("kbtlint self-test: all seeded violations detected")
+        return 0
+
+    passes = core.all_passes()
+    if ns.list_passes:
+        for name in sorted(passes):
+            print(name)
+        return 0
+    if ns.passes:
+        unknown = set(ns.passes) - set(passes)
+        if unknown:
+            print(f"unknown pass(es): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        passes = {k: v for k, v in passes.items() if k in ns.passes}
+
+    t0 = time.time()
+    project = core.load_project()
+    findings = []
+    for name in sorted(passes):
+        findings.extend(passes[name](project))
+
+    if ns.no_allowlist:
+        kept, suppressed, stale = findings, [], []
+    else:
+        try:
+            entries = core.load_allowlist(ns.allow_file)
+        except (core.AllowlistError, ValueError) as exc:
+            print(f"allowlist error: {exc}", file=sys.stderr)
+            return 2
+        kept, suppressed, stale = core.apply_allowlist(findings, entries)
+
+    for finding in kept:
+        print(finding.render())
+    for entry in stale:
+        print(
+            f"STALE allowlist entry (matched nothing): pass={entry.pass_id} "
+            f"file={entry.file} match={entry.match!r} — delete it or fix "
+            f"the match; dead suppressions hide the next real finding",
+        )
+    elapsed = time.time() - t0
+    print(
+        f"kbtlint: {len(passes)} pass(es) over {len(project.files)} "
+        f"file(s) in {elapsed:.1f}s — {len(kept)} finding(s), "
+        f"{len(suppressed)} allowlisted, {len(stale)} stale "
+        f"allowlist entr(y/ies)",
+        file=sys.stderr,
+    )
+    return 1 if (kept or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

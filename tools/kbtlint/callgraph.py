@@ -1,0 +1,157 @@
+"""Approximate project call graph for reachability questions.
+
+Both graph consumers ask the same shape of question: "may calling this
+function (transitively) do X" — acquire a lock, stamp the dirty
+ledger. The resolution is deliberately name-based and conservative:
+
+- ``self.m(...)`` resolves to methods named ``m`` — preferring the
+  caller's own class, then any class in the caller's module, then a
+  project-unique method of that name (mixins split classes across
+  files: ``SchedulerCache`` methods live in cache.py AND
+  event_handlers.py).
+- ``obj.m(...)`` resolves to a project-unique method/function named
+  ``m`` — unless ``m`` is in the stoplist of ultra-common names, where
+  name-matching would wire unrelated code together (``.get`` on a
+  queue is not ``Registry.get``).
+- ``f(...)`` resolves to a module-level function in the caller's
+  module, then a project-unique one.
+
+Unresolved calls contribute nothing (under-approximation); common-name
+calls are skipped (avoiding over-approximation). Both error directions
+exist — this is a lint, not a verifier — but the fixed point over the
+resolved edges catches every same-named in-project chain, which is
+what the PR 7/PR 8 bug classes were.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Set, Tuple
+
+from .core import FuncDef, Project, call_name, iter_functions
+
+# Method names too generic to resolve by name across the project.
+COMMON_NAMES = frozenset({
+    "get", "put", "pop", "add", "remove", "update", "set", "clear",
+    "items", "keys", "values", "append", "extend", "discard", "copy",
+    "clone", "submit", "wait", "notify", "notify_all", "acquire",
+    "release", "start", "join", "run", "name", "close", "open", "read",
+    "write", "sort", "index", "count", "format", "strip", "split",
+    "setdefault", "difference_update", "union", "encode", "decode",
+})
+
+
+@dataclass
+class CallSite:
+    name: str
+    recv_self: bool  # receiver is `self`/`cls`
+    bare: bool  # plain `f(...)` (no receiver)
+    node: ast.Call
+
+
+@dataclass
+class FuncEntry:
+    fd: FuncDef
+    calls: List[CallSite] = field(default_factory=list)
+
+
+def get_callgraph(project: Project) -> "CallGraph":
+    """One CallGraph per Project: lock-order and dirty-ledger both need
+    it, and construction (plus the transitive fixed points) is the
+    expensive half of a driver run."""
+    graph = getattr(project, "_kbtlint_callgraph", None)
+    if graph is None:
+        graph = CallGraph(project)
+        project._kbtlint_callgraph = graph
+    return graph
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.entries: Dict[str, FuncEntry] = {}
+        # name -> [FuncEntry...] across the project
+        self.by_name: Dict[str, List[FuncEntry]] = {}
+        # (rel, name) -> [FuncEntry...] in one module
+        self.by_module_name: Dict[Tuple[str, str], List[FuncEntry]] = {}
+        for pf in project.files:
+            for fd in iter_functions(pf):
+                entry = FuncEntry(fd=fd)
+                entry.calls = _collect_calls(fd.node)
+                self.entries[fd.key] = entry
+                self.by_name.setdefault(fd.name, []).append(entry)
+                self.by_module_name.setdefault(
+                    (fd.rel, fd.name), []
+                ).append(entry)
+
+    def resolve(self, caller: FuncEntry, site: CallSite) -> List[FuncEntry]:
+        name = site.name
+        if site.recv_self:
+            same_class = [
+                e for e in self.by_name.get(name, ())
+                if e.fd.cls is not None and e.fd.cls == caller.fd.cls
+            ]
+            if same_class:
+                return same_class
+            # Mixin split: methods of one runtime class under different
+            # class names across the package (EventHandlersMixin +
+            # SchedulerCache). Any method of that name counts.
+            methods = [
+                e for e in self.by_name.get(name, ()) if e.fd.cls is not None
+            ]
+            return methods
+        if site.bare:
+            local = self.by_module_name.get((caller.fd.rel, name), [])
+            if local:
+                return local
+            cands = self.by_name.get(name, [])
+            return cands if len(cands) == 1 else []
+        # obj.m(...): every project def of that non-common name — an
+        # over-approximation (interface + N implementations all count),
+        # which is the right direction for "may this call acquire X".
+        if name in COMMON_NAMES:
+            return []
+        return list(self.by_name.get(name, ()))
+
+    def transitive_marks(self, direct: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+        """Fixed point: propagate per-function mark sets (e.g. lock ids
+        the function may acquire) backward along call edges — a caller
+        inherits its callees' marks."""
+        marks: Dict[str, Set[str]] = {
+            key: set(direct.get(key, ())) for key in self.entries
+        }
+        changed = True
+        while changed:
+            changed = False
+            for key, entry in self.entries.items():
+                acc = marks[key]
+                before = len(acc)
+                for site in entry.calls:
+                    for callee in self.resolve(entry, site):
+                        acc |= marks.get(callee.fd.key, set())
+                if len(acc) != before:
+                    changed = True
+        return marks
+
+
+def _collect_calls(func_node: ast.AST) -> List[CallSite]:
+    sites: List[CallSite] = []
+    for node in ast.walk(func_node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name is None:
+            continue
+        fn = node.func
+        recv_self = bare = False
+        if isinstance(fn, ast.Name):
+            bare = True
+        elif isinstance(fn, ast.Attribute):
+            recv = fn.value
+            recv_self = isinstance(recv, ast.Name) and recv.id in (
+                "self", "cls"
+            )
+        sites.append(
+            CallSite(name=name, recv_self=recv_self, bare=bare, node=node)
+        )
+    return sites

@@ -1,0 +1,280 @@
+"""Pass 4: unified doc↔code censuses — exact, both directions.
+
+Hand-maintained doc tables rot the first time someone adds a name
+without a row (or prunes one without deleting its row). Each census
+here pins a table to the code-extracted truth and fails loudly either
+way:
+
+- **metrics**: ``metrics.REGISTRY`` vs the census tables in
+  ``doc/design/metrics.md`` (the guard formerly run standalone by
+  ``tests/unit/test_metrics_census.py``, which stays as the runtime
+  twin — this pass is the fast-fail front door in ``make kbtlint``);
+- **env vars**: every ``KBT_*`` string literal in the scheduler
+  package (env accesses are the only reason such a literal exists) vs
+  the marked table in ``doc/design/configuration.md``;
+- **flight-record keys**: keys written into flight-recorder records
+  (record dict literals + ``rec[...]`` writes + ``annotate(...)``
+  literals + ``end_cycle(...)`` extras) vs the marked table in
+  ``doc/design/observability.md``;
+- **debug-vars keys**: top-level keys of the ``/debug/vars`` payload
+  (``cli/server.py _debug_vars``) vs its marked table in
+  ``doc/design/observability.md``.
+
+Marked tables are delimited by ``<!-- kbtlint-census:NAME -->`` /
+``<!-- /kbtlint-census:NAME -->`` comments; rows are ``| `token` |
+...``. Names starting with ``_`` are internal and excluded on both
+sides.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import REPO, Finding, Project, call_name, register_pass
+
+PASS_ID = "census"
+
+_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_/]+)`\s*\|")
+_KBT_RE = re.compile(r"^KBT_[A-Z0-9_]+$")
+
+CONFIG_DOC = os.path.join("doc", "design", "configuration.md")
+OBS_DOC = os.path.join("doc", "design", "observability.md")
+METRICS_DOC = os.path.join("doc", "design", "metrics.md")
+
+
+def _marked_rows(doc_path: str, name: str) -> Tuple[Optional[List[str]], int]:
+    """Row tokens (in order, duplicates kept) of the census region(s)
+    named ``name`` in ``doc_path`` — a doc may carry several marked
+    regions under one name (metrics.md wraps each of its tables).
+    (None, 0) when no marker exists."""
+    path = os.path.join(REPO, doc_path)
+    if not os.path.exists(path):
+        return None, 0
+    begin = f"<!-- kbtlint-census:{name} -->"
+    end = f"<!-- /kbtlint-census:{name} -->"
+    tokens: List[str] = []
+    inside = False
+    begin_line = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            stripped = line.strip()
+            if stripped == begin:
+                inside = True
+                if begin_line == 0:
+                    begin_line = lineno
+                continue
+            if stripped == end:
+                inside = False
+                continue
+            if inside:
+                m = _ROW_RE.match(stripped)
+                if m:
+                    tokens.append(m.group(1))
+    if begin_line == 0:
+        return None, 0
+    return tokens, begin_line
+
+
+def read_marked_table(doc_path: str, name: str) -> Tuple[Optional[Set[str]], int]:
+    """Token set of the census table ``name`` in ``doc_path``, plus the
+    first marker's line for finding attribution."""
+    rows, line = _marked_rows(doc_path, name)
+    return (None if rows is None else set(rows)), line
+
+
+def compare_census(
+    label: str,
+    code_names: Set[str],
+    doc_names: Optional[Set[str]],
+    doc_rel: str,
+    doc_line: int,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    if doc_names is None:
+        findings.append(Finding(
+            PASS_ID, doc_rel, 1,
+            f"{label} census table missing: no "
+            f"<!-- kbtlint-census:... --> marker found in {doc_rel}",
+        ))
+        return findings
+    for name in sorted(code_names - doc_names):
+        findings.append(Finding(
+            PASS_ID, doc_rel, doc_line,
+            f"{label} census: {name!r} exists in code but has no row "
+            f"in {doc_rel}",
+        ))
+    for name in sorted(doc_names - code_names):
+        findings.append(Finding(
+            PASS_ID, doc_rel, doc_line,
+            f"{label} census: {name!r} has a row in {doc_rel} but no "
+            f"longer exists in code (stale row)",
+        ))
+    return findings
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def _load_registry_names() -> Set[str]:
+    """Import kube_batch_tpu/metrics/metrics.py standalone (it is
+    stdlib-only) — the same REGISTRY truth the runtime twin test uses,
+    without paying a package import."""
+    path = os.path.join(REPO, "kube_batch_tpu", "metrics", "metrics.py")
+    spec = importlib.util.spec_from_file_location("_kbtlint_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return set(mod.REGISTRY.names())
+
+
+def metrics_census() -> List[Finding]:
+    # Marked regions only (metrics.md wraps each metric table): a
+    # non-registry table elsewhere in the doc (bucket policy, env
+    # cross-references) must not read as stale census rows.
+    rows, line = _marked_rows(METRICS_DOC, "metrics")
+    findings: List[Finding] = []
+    if rows is None:
+        return compare_census("metrics", _load_registry_names(), None,
+                              METRICS_DOC, 0)
+    for name in sorted({n for n in rows if rows.count(n) > 1}):
+        findings.append(Finding(
+            PASS_ID, METRICS_DOC, line,
+            f"metrics census: duplicate row for {name!r}",
+        ))
+    findings.extend(compare_census(
+        "metrics", _load_registry_names(), set(rows), METRICS_DOC, line
+    ))
+    return findings
+
+
+# -- env vars ----------------------------------------------------------------
+
+
+def collect_env_names(project: Project) -> Set[str]:
+    names: Set[str] = set()
+    for pf in project.files:
+        for node in ast.walk(pf.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _KBT_RE.match(node.value)
+            ):
+                names.add(node.value)
+    return names
+
+
+# -- flight-record keys ------------------------------------------------------
+
+_REC_NAMES = frozenset({"rec", "prev", "open_rec"})
+
+
+def collect_flight_keys(project: Project) -> Set[str]:
+    keys: Set[str] = set()
+    recorder = None
+    for pf in project.files:
+        if pf.rel.replace("\\", "/").endswith("obs/flightrecorder.py"):
+            recorder = pf
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "annotate" and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str
+                ):
+                    keys.add(first.value)
+            elif name == "end_cycle":
+                for kw in node.keywords:
+                    if kw.arg is not None and kw.arg != "ok":
+                        keys.add(kw.arg)
+    if recorder is not None:
+        for node in ast.walk(recorder.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id in _REC_NAMES
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    for key in node.value.keys:
+                        if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str
+                        ):
+                            keys.add(key.value)
+                elif (
+                    isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id in _REC_NAMES
+                    and isinstance(target.slice, ast.Constant)
+                    and isinstance(target.slice.value, str)
+                ):
+                    keys.add(target.slice.value)
+    return {k for k in keys if not k.startswith("_")}
+
+
+# -- /debug/vars keys --------------------------------------------------------
+
+
+def collect_debug_vars_keys(project: Project) -> Set[str]:
+    keys: Set[str] = set()
+    for pf in project.files:
+        if not pf.rel.replace("\\", "/").endswith("cli/server.py"):
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name != "_debug_vars":
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    target = sub.targets[0]
+                    if (
+                        isinstance(target, ast.Name)
+                        and target.id == "out"
+                        and isinstance(sub.value, ast.Dict)
+                    ):
+                        for key in sub.value.keys:
+                            if isinstance(key, ast.Constant) and isinstance(
+                                key.value, str
+                            ):
+                                keys.add(key.value)
+                    elif (
+                        isinstance(target, ast.Subscript)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "out"
+                        and isinstance(target.slice, ast.Constant)
+                        and isinstance(target.slice.value, str)
+                    ):
+                        keys.add(target.slice.value)
+    return keys
+
+
+@register_pass(PASS_ID)
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    findings.extend(metrics_census())
+
+    env_doc, env_line = read_marked_table(CONFIG_DOC, "env-vars")
+    findings.extend(compare_census(
+        "KBT env-var", collect_env_names(project), env_doc,
+        CONFIG_DOC, env_line,
+    ))
+
+    flight_doc, flight_line = read_marked_table(OBS_DOC, "flight-keys")
+    findings.extend(compare_census(
+        "flight-record key", collect_flight_keys(project), flight_doc,
+        OBS_DOC, flight_line,
+    ))
+
+    debug_doc, debug_line = read_marked_table(OBS_DOC, "debug-vars")
+    findings.extend(compare_census(
+        "/debug/vars key", collect_debug_vars_keys(project), debug_doc,
+        OBS_DOC, debug_line,
+    ))
+
+    findings.sort(key=lambda f: (f.file, f.line, f.message))
+    return findings

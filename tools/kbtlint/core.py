@@ -1,0 +1,271 @@
+"""kbtlint core: project model, findings, allowlist, pass registry.
+
+Everything here is stdlib-only and import-light on purpose: the driver
+must run in a bare CI container in seconds, before anything heavy
+(jax) is importable or warm.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Default analysis scope: the scheduler package. Tests/tools are
+# deliberately out of scope — they exercise invariants, they don't
+# carry them.
+DEFAULT_TARGETS = ("kube_batch_tpu",)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One file:line defect reported by a pass."""
+
+    pass_id: str
+    file: str  # repo-relative path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+@dataclass
+class ProjectFile:
+    path: str  # absolute
+    rel: str  # repo-relative
+    source: str
+    tree: ast.AST
+
+    @property
+    def lines(self) -> List[str]:
+        return self.source.splitlines()
+
+
+@dataclass
+class Project:
+    """Parsed view of the analysis targets, shared across passes so
+    every file is read and parsed exactly once per run."""
+
+    root: str
+    files: List[ProjectFile] = field(default_factory=list)
+
+    def by_rel(self, rel: str) -> Optional[ProjectFile]:
+        for pf in self.files:
+            if pf.rel == rel:
+                return pf
+        return None
+
+
+def _iter_py_files(root: str, targets: Sequence[str]):
+    for target in targets:
+        path = os.path.join(root, target)
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [
+                d for d in dirnames if d not in ("__pycache__", "csrc")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def load_project(root: str = REPO,
+                 targets: Sequence[str] = DEFAULT_TARGETS) -> Project:
+    project = Project(root=root)
+    for path in sorted(_iter_py_files(root, targets)):
+        with open(path) as f:
+            source = f.read()
+        # Syntax errors are tools/lint.py's finding; a file that does
+        # not parse simply cannot be analyzed here.
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue
+        project.files.append(
+            ProjectFile(
+                path=path,
+                rel=os.path.relpath(path, root),
+                source=source,
+                tree=tree,
+            )
+        )
+    return project
+
+
+def load_snippet(source: str, rel: str = "<snippet>") -> Project:
+    """A single-source Project for fixtures and tests."""
+    project = Project(root=REPO)
+    project.files.append(
+        ProjectFile(
+            path=rel, rel=rel, source=source,
+            tree=ast.parse(source, filename=rel),
+        )
+    )
+    return project
+
+
+# -- allowlist ---------------------------------------------------------------
+
+ALLOWLIST_PATH = os.path.join(REPO, "tools", "kbtlint", "allowlist.json")
+
+
+@dataclass
+class AllowEntry:
+    """One reasoned suppression. ``match`` is a substring matched
+    against the finding message; ``file`` is the exact repo-relative
+    path (line numbers are deliberately NOT part of the key — they
+    churn on every edit above the site)."""
+
+    pass_id: str
+    file: str
+    match: str
+    reason: str
+    used: bool = False
+
+    def covers(self, finding: Finding) -> bool:
+        return (
+            finding.pass_id == self.pass_id
+            and finding.file == self.file
+            and self.match in finding.message
+        )
+
+
+class AllowlistError(ValueError):
+    pass
+
+
+def load_allowlist(path: str = ALLOWLIST_PATH) -> List[AllowEntry]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        raw = json.load(f)
+    entries = []
+    for i, item in enumerate(raw):
+        missing = {"pass", "file", "match", "reason"} - set(item)
+        if missing:
+            raise AllowlistError(
+                f"allowlist entry {i} missing {sorted(missing)}: {item}"
+            )
+        if not str(item["reason"]).strip():
+            raise AllowlistError(
+                f"allowlist entry {i} has an empty reason — every "
+                f"suppression must say WHY: {item}"
+            )
+        entries.append(
+            AllowEntry(
+                pass_id=item["pass"], file=item["file"],
+                match=item["match"], reason=item["reason"],
+            )
+        )
+    return entries
+
+
+def apply_allowlist(
+    findings: Sequence[Finding], entries: Sequence[AllowEntry]
+) -> Tuple[List[Finding], List[Finding], List[AllowEntry]]:
+    """Returns (kept, suppressed, stale_entries). A stale entry — one
+    that matched nothing this run — is itself an error: dead
+    suppressions hide the next real finding that happens to match."""
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for finding in findings:
+        hit = next((e for e in entries if e.covers(finding)), None)
+        if hit is not None:
+            hit.used = True
+            suppressed.append(finding)
+        else:
+            kept.append(finding)
+    stale = [e for e in entries if not e.used]
+    return kept, suppressed, stale
+
+
+# -- pass registry -----------------------------------------------------------
+
+PassFn = Callable[[Project], List[Finding]]
+_PASSES: Dict[str, PassFn] = {}
+
+
+def register_pass(pass_id: str):
+    def deco(fn: PassFn) -> PassFn:
+        _PASSES[pass_id] = fn
+        return fn
+
+    return deco
+
+
+def all_passes() -> Dict[str, PassFn]:
+    # Import side effect: pass modules self-register. Kept lazy so
+    # `from tools.kbtlint import core` stays cheap for tests.
+    from . import census, dirty_ledger, jit_hygiene, lock_order  # noqa: F401
+
+    return dict(_PASSES)
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` -> ["a", "b", "c"]; None when the expression is not a
+    pure name/attribute chain (calls, subscripts...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """The called name: ``f(...)`` -> "f", ``a.b.f(...)`` -> "f"."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+@dataclass
+class FuncDef:
+    """One function/method with its defining context. Nested defs are
+    folded into their enclosing function — kbtlint's reachability
+    questions ("does a stamp happen in the same function") treat a
+    closure as part of its host."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    name: str
+    cls: Optional[str]
+    rel: str
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+    @property
+    def key(self) -> str:
+        return f"{self.rel}::{self.qualname}"
+
+
+def iter_functions(pf: ProjectFile):
+    """Yield top-level functions and methods (one FuncDef per def;
+    nested defs are not yielded separately — see FuncDef)."""
+
+    def walk(nodes, cls: Optional[str]):
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield FuncDef(node=node, name=node.name, cls=cls, rel=pf.rel)
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, node.name)
+            elif isinstance(node, (ast.If, ast.Try)):
+                yield from walk(ast.iter_child_nodes(node), cls)
+
+    yield from walk(pf.tree.body, None)

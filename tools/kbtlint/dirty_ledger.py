@@ -1,0 +1,184 @@
+"""Pass 2: dirty-ledger completeness (the PR 8 warm-path staleness
+class, mechanical).
+
+The O(churn) warm/incremental paths are sound only if *every*
+mirror-side mutation of the guarded NodeInfo/JobInfo allocation state
+stamps the cache's dirty ledger (``_stamp_dirty`` full, or
+``_stamp_dirty_alloc`` narrow) — one missed stamp means the
+delta-aware tensorize silently serves stale tensors for that name.
+(The incremental *snapshot* itself is fingerprint-verified and immune;
+the dirty sets feed the downstream tensorize/predicate caches and the
+warm-solve state machine, which DO trust them.)
+
+Scope: ``kube_batch_tpu/cache/`` — the only layer that mutates the
+mirror. Sessions/actions mutate snapshot *clones*, which never need
+stamping; api/ defines the mutators but owns no ledger.
+
+Rule: a cache-layer function that (a) calls a JobInfo/NodeInfo
+allocation mutator on a non-self receiver, or (b) writes/deletes an
+entry of ``self.jobs`` / ``self.nodes``, must reach a ledger stamp
+within the same function — directly, or through a (transitively
+resolved) call it makes, e.g. ``bind()`` stamping via
+``_bind_bookkeeping()``. Helpers that mutate but intentionally defer
+the stamp to every caller get an allowlist entry naming that contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .callgraph import get_callgraph
+from .core import (
+    Finding,
+    Project,
+    attr_chain,
+    call_name,
+    iter_functions,
+    register_pass,
+)
+
+PASS_ID = "dirty-ledger"
+
+# JobInfo/NodeInfo methods that move the guarded allocation state
+# (node idle/used/task count, job status buckets, scheduling spec).
+MUTATORS = frozenset({
+    # NodeInfo (api/node_info.py)
+    "add_task", "remove_task", "update_task", "add_tasks_with_fallback",
+    "set_node",
+    # JobInfo (api/job_info.py)
+    "add_task_info", "delete_task_info", "update_task_status",
+    "update_tasks_status", "set_pod_group", "unset_pod_group",
+    "set_pdb", "unset_pdb",
+})
+
+# Functions that ARE the ledger (or write it directly).
+STAMP_NAMES = frozenset({"_stamp_dirty", "_stamp_dirty_alloc"})
+LEDGER_SETS = frozenset({
+    "_dirty_jobs", "_dirty_nodes", "_dirty_jobs_alloc",
+    "_dirty_nodes_alloc", "_full_backlog_jobs", "_full_backlog_nodes",
+})
+
+MIRROR_MAPS = frozenset({"jobs", "nodes"})
+
+
+def _is_mirror_map(expr: ast.AST) -> bool:
+    chain = attr_chain(expr)
+    return (
+        chain is not None
+        and len(chain) == 2
+        and chain[0] == "self"
+        and chain[1] in MIRROR_MAPS
+    )
+
+
+def _function_mutations(func_node: ast.AST) -> List[ast.AST]:
+    """Mutation sites in one function: mirror-map writes and mutator
+    calls on non-self receivers."""
+    sites: List[ast.AST] = []
+    for node in ast.walk(func_node):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in MUTATORS and isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                if not (
+                    isinstance(recv, ast.Name) and recv.id in ("self", "cls")
+                ):
+                    sites.append(node)
+            elif (
+                name == "pop"
+                and isinstance(node.func, ast.Attribute)
+                and _is_mirror_map(node.func.value)
+            ):
+                sites.append(node)
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript) and _is_mirror_map(
+                    target.value
+                ):
+                    sites.append(node)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript) and _is_mirror_map(
+                    target.value
+                ):
+                    sites.append(node)
+    return sites
+
+
+def _stamps_directly(func_node: ast.AST) -> bool:
+    for node in ast.walk(func_node):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in STAMP_NAMES:
+                return True
+            # Direct ledger-set writes (update_pod demotes stamps by
+            # hand): self._dirty_jobs.add(...) etc.
+            if (
+                name in ("add", "update", "discard")
+                and isinstance(node.func, ast.Attribute)
+            ):
+                chain = attr_chain(node.func.value)
+                if (
+                    chain is not None
+                    and len(chain) == 2
+                    and chain[0] == "self"
+                    and chain[1] in LEDGER_SETS
+                ):
+                    return True
+    return False
+
+
+@register_pass(PASS_ID)
+def run(project: Project) -> List[Finding]:
+    def in_scope(rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        if rel.startswith("kube_batch_tpu/"):
+            # Only the mirror layer; sessions/actions mutate clones.
+            return rel.startswith("kube_batch_tpu/cache/")
+        return True  # fixtures / snippets analyze as-is
+
+    cache_files = [pf for pf in project.files if in_scope(pf.rel)]
+    if not cache_files:
+        return []
+
+    graph = get_callgraph(project)
+
+    # Transitive "reaches a stamp" over the whole project graph (cache
+    # functions only ever resolve to in-package callees for these).
+    direct: Dict[str, Set[str]] = {}
+    for key, entry in graph.entries.items():
+        if (
+            entry.fd.name in STAMP_NAMES
+            or _stamps_directly(entry.fd.node)
+        ):
+            direct[key] = {"stamp"}
+    stamps = graph.transitive_marks(direct)
+
+    findings: List[Finding] = []
+    for pf in cache_files:
+        for fd in iter_functions(pf):
+            if fd.name in STAMP_NAMES:
+                continue
+            sites = _function_mutations(fd.node)
+            if not sites:
+                continue
+            if "stamp" in stamps.get(fd.key, set()):
+                continue
+            for site in sites:
+                desc = (
+                    f"call {call_name(site)}()"
+                    if isinstance(site, ast.Call)
+                    else "mirror-map write"
+                )
+                findings.append(Finding(
+                    PASS_ID, fd.rel, site.lineno,
+                    f"unstamped allocation mutation in {fd.qualname}: "
+                    f"{desc} mutates guarded JobInfo/NodeInfo state but "
+                    f"no dirty-ledger stamp (_stamp_dirty / "
+                    f"_stamp_dirty_alloc) is reachable in this function "
+                    f"— the delta-aware tensorize would serve stale "
+                    f"tensors for this name (PR 8 staleness class)",
+                ))
+    findings.sort(key=lambda f: (f.file, f.line, f.message))
+    return findings

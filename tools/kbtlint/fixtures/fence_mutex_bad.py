@@ -1,0 +1,26 @@
+"""kbtlint self-test fixture: the PR 7 fence/mutex deadlock shape
+(known-bad).
+
+The fence path runs on the watchdog thread precisely when a wedged
+cycle may be deadlocked HOLDING the mutex — so ``fence()`` acquiring
+the mutex (here via a helper, to exercise the call-through analysis)
+joins that deadlock. ``_fence_lock`` is declared a LEAF lock: the
+lock-order pass must flag any acquisition while it is held.
+"""
+
+import threading
+
+
+class MiniCache:
+    def __init__(self):
+        self.mutex = threading.RLock()
+        self._fence_lock = threading.Lock()
+        self._fence_reason = None
+
+    def _note_reason_locked(self, reason):
+        with self.mutex:  # the PR 7 bug: fencing joins the mutex queue
+            self._fence_reason = reason
+
+    def fence(self, reason):
+        with self._fence_lock:
+            self._note_reason_locked(reason)

@@ -1,0 +1,32 @@
+"""kbtlint self-test fixture: jit hygiene violations (known-bad).
+
+Python branch on a traced value, host syncs, and donated-buffer reuse.
+"""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:
+        return x
+    return -x
+
+
+@jax.jit
+def bad_sync(x):
+    y = np.asarray(x)
+    return float(x) + y.sum()
+
+
+def _step(buf, delta):
+    return buf + delta
+
+
+donated_step = jax.jit(_step, donate_argnums=(0,))
+
+
+def caller(buf, delta):
+    out = donated_step(buf, delta)
+    return out, buf

@@ -1,0 +1,39 @@
+"""kbtlint self-test fixture: hygienic jit code (known-good).
+
+Branches on static properties (shapes, static_argnames, ``is None``),
+computes with jnp — exactly how shape-polymorphic jit code is supposed
+to look.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_where(x):
+    if x.shape[0] > 4:
+        return jnp.where(x > 0, x, -x)
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("wide",))
+def good_static(x, wide=False):
+    if wide:
+        return x * 2
+    if x is None:
+        return jnp.zeros(())
+    total = jnp.sum(x)
+    return total
+
+
+def _helper(x, scale):
+    if scale > 1:  # static at every call site below
+        return x * scale
+    return x
+
+
+@jax.jit
+def good_helper_call(x):
+    return _helper(x, 4)

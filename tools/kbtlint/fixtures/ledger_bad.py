@@ -1,0 +1,14 @@
+"""kbtlint self-test fixture: unstamped ledger mutation (known-bad).
+
+``delete_pdb_like`` mutates a job's scheduling spec with no dirty
+stamp reachable — the PR 8 warm-path staleness class.
+"""
+
+
+class MiniCache:
+    def _stamp_dirty(self, job_key=None, node_name=None):
+        if job_key:
+            self._dirty_jobs.add(job_key)
+
+    def delete_pdb_like(self, job):
+        job.unset_pdb()

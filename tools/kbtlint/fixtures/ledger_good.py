@@ -1,0 +1,21 @@
+"""kbtlint self-test fixture: stamped ledger mutations (known-good).
+
+``bind_like`` stamps transitively (through ``_bookkeeping``) —
+exercising the call-through half of the reachability rule.
+"""
+
+
+class MiniCache:
+    def _stamp_dirty(self, job_key=None, node_name=None):
+        if job_key:
+            self._dirty_jobs.add(job_key)
+        if node_name:
+            self._dirty_nodes.add(node_name)
+
+    def _bookkeeping(self, job, node, task):
+        self._stamp_dirty(job.uid, node.name)
+        node.add_task(task)
+
+    def bind_like(self, job, node, task):
+        self._bookkeeping(job, node, task)
+        job.update_task_status(task, "BINDING")

@@ -1,0 +1,23 @@
+"""kbtlint self-test fixture: a lock-order CYCLE (known-bad).
+
+``forward`` takes a→b, ``backward`` takes b→a: two threads running one
+each deadlock. The lock-order pass must report the cycle.
+"""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def forward(self):
+        with self.lock_a:
+            with self.lock_b:
+                return 1
+
+    def backward(self):
+        with self.lock_b:
+            with self.lock_a:
+                return 2

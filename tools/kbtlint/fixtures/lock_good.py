@@ -1,0 +1,28 @@
+"""kbtlint self-test fixture: consistent lock order (known-good)."""
+
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+        self._fence_lock = threading.Lock()
+
+    def nested(self):
+        with self.lock_a:
+            with self.lock_b:
+                return 1
+
+    def a_only(self):
+        with self.lock_a:
+            return 2
+
+    def b_only(self):
+        with self.lock_b:
+            return 3
+
+    def fence(self, reason):
+        # Leaf lock held alone: nothing acquired under it.
+        with self._fence_lock:
+            self._reason = reason

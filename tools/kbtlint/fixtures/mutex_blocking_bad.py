@@ -1,0 +1,19 @@
+"""kbtlint self-test fixture: blocking work under cache.mutex
+(known-bad).
+
+A device→host sync and a thread join while holding a ``mutex`` lock
+stall every watch event and bind in the process for the duration.
+"""
+
+import threading
+
+
+class MiniCache:
+    def __init__(self):
+        self.mutex = threading.RLock()
+
+    def solve_under_lock(self, result, worker):
+        with self.mutex:
+            result.block_until_ready()
+            worker.join(5.0)
+            return result

@@ -1,0 +1,512 @@
+"""Pass 3: jit hygiene inside traced code.
+
+Finds functions compiled by ``jax.jit`` / ``shard_map`` — via
+decorator (``@jax.jit``, ``@functools.partial(jax.jit, ...)``) or
+registration (``X = jax.jit(fn, ...)``, ``shard_map(fn, ...)``) — and
+taint-checks their bodies (plus same-module helpers they call, with
+call-site-accurate parameter taint):
+
+- **python branching on traced values**: ``if``/``while``/``assert``/
+  ``for`` over a tainted expression raises TracerBoolConversionError
+  at trace time on the lucky path and silently bakes in one branch on
+  the unlucky one (a value that happens to be concrete under
+  ``interpret=True`` testing, traced in production);
+- **host syncs**: ``np.asarray``/``np.array``/``float``/``int``/
+  ``bool`` on traced values, ``.item()``/``.tolist()``/
+  ``.block_until_ready()``/``jax.device_get`` — a device→host block
+  point inside the program defeats the async dispatch the cycle
+  overlap window depends on;
+- **donated-buffer reuse**: a caller passing a buffer into a
+  module-level jit registered with ``donate_argnums`` and then
+  reading the same variable afterwards — the donated buffer's memory
+  may already be aliased by the output.
+
+Static arguments (``static_argnames``) are untainted; so are shape/
+dtype/ndim/size attribute reads, ``len``/``isinstance``/``type`` and
+``is``/``is not`` comparisons — branching on those is exactly how
+shape-polymorphic jit code is SUPPOSED to branch.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    Finding,
+    Project,
+    ProjectFile,
+    attr_chain,
+    call_name,
+    register_pass,
+)
+
+PASS_ID = "jit-hygiene"
+
+STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "sharding"})
+STATIC_CALLS = frozenset({"isinstance", "len", "type", "issubclass",
+                          "hasattr", "callable", "range", "enumerate",
+                          "zip"})
+HOST_CONVERSIONS = frozenset({"float", "int", "bool", "complex"})
+HOST_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+HOST_NP_FUNCS = frozenset({"asarray", "array", "copy", "save", "savez"})
+MAX_HELPER_DEPTH = 4
+
+
+@dataclass
+class JitRoot:
+    func: ast.AST
+    rel: str
+    name: str
+    static_names: Set[str]
+    donate_argnums: Tuple[int, ...] = ()
+    registered_as: Optional[str] = None  # module-level jitted name
+
+
+def _const_str_tuple(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    return out
+
+
+def _const_int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    out: List[int] = []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+    elif isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.append(node.value)
+    return tuple(out)
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` / ``shard_map`` reference."""
+    chain = attr_chain(node)
+    if chain is None:
+        return False
+    return chain[-1] in ("jit", "shard_map")
+
+
+def _jit_call_statics(call: ast.Call) -> Tuple[Set[str], Tuple[int, ...]]:
+    statics: Set[str] = set()
+    donate: Tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            statics |= _const_str_tuple(kw.value)
+        elif kw.arg == "donate_argnums":
+            donate = _const_int_tuple(kw.value)
+    return statics, donate
+
+
+def _collect_roots(pf: ProjectFile) -> Tuple[List[JitRoot], Dict[str, JitRoot]]:
+    """Jit roots in one module + {module-level jitted name: root} for
+    the donated-reuse call-site check."""
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+
+    roots: List[JitRoot] = []
+    registered: Dict[str, JitRoot] = {}
+    seen: Set[int] = set()
+
+    def add_root(func_node, statics, donate, registered_as=None):
+        root = JitRoot(
+            func=func_node, rel=pf.rel, name=func_node.name,
+            static_names=statics, donate_argnums=donate,
+            registered_as=registered_as,
+        )
+        if registered_as:
+            # The generic jax.jit(fn) walk may have claimed the body
+            # already — the NAME binding (and its donate_argnums) must
+            # still register for the call-site reuse check.
+            registered[registered_as] = root
+        if id(func_node) in seen:
+            return
+        seen.add(id(func_node))
+        roots.append(root)
+
+    # Decorated: @jax.jit / @functools.partial(jax.jit, ...)
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            if _is_jit_callable(deco):
+                add_root(node, set(), ())
+            elif isinstance(deco, ast.Call):
+                if _is_jit_callable(deco.func):
+                    statics, donate = _jit_call_statics(deco)
+                    add_root(node, statics, donate)
+                elif call_name(deco) == "partial" and deco.args and \
+                        _is_jit_callable(deco.args[0]):
+                    statics, donate = _jit_call_statics(deco)
+                    add_root(node, statics, donate)
+
+    # Registered: X = jax.jit(fn, ...) / jax.jit(fn, ...) anywhere /
+    # shard_map(fn, mesh, ...).
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call) or not _is_jit_callable(node.func):
+            continue
+        if not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name) and target.id in defs:
+            statics, donate = _jit_call_statics(node)
+            add_root(defs[target.id], statics, donate)
+
+    # Names bound at module level to a jit call (donated-reuse check).
+    for node in pf.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+            and _is_jit_callable(node.value.func)
+            and node.value.args
+            and isinstance(node.value.args[0], ast.Name)
+            and node.value.args[0].id in defs
+        ):
+            statics, donate = _jit_call_statics(node.value)
+            add_root(defs[node.value.args[0].id], statics, donate,
+                     registered_as=node.targets[0].id)
+
+    return roots, registered
+
+
+class _TaintChecker:
+    """Per-function taint walk. One instance per (function, taint
+    signature); helper calls recurse with call-site arg taint."""
+
+    def __init__(self, pf: ProjectFile, defs: Dict[str, ast.AST],
+                 findings: List[Finding],
+                 memo: Dict[Tuple[int, frozenset], bool],
+                 depth: int):
+        self.pf = pf
+        self.defs = defs
+        self.findings = findings
+        self.memo = memo
+        self.depth = depth
+        self.tainted: Set[str] = set()
+        self.returns_tainted = False
+
+    # -- taint of expressions ------------------------------------------------
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.expr_tainted(node.left) or any(
+                self.expr_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.Call):
+            return self.call_tainted(node)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(
+                self.expr_tainted(v)
+                for v in list(node.keys) + list(node.values)
+                if v is not None
+            )
+        if isinstance(node, ast.Subscript):
+            return self.expr_tainted(node.value) or self.expr_tainted(
+                node.slice
+            )
+        if isinstance(node, ast.Slice):
+            return any(
+                self.expr_tainted(p)
+                for p in (node.lower, node.upper, node.step)
+                if p is not None
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self.expr_tainted(node.left) or self.expr_tainted(
+                node.right
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_tainted(node.operand)
+        if isinstance(node, ast.IfExp):
+            return (
+                self.expr_tainted(node.body)
+                or self.expr_tainted(node.orelse)
+            )
+        if isinstance(node, ast.Starred):
+            return self.expr_tainted(node.value)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return any(
+                self.expr_tainted(gen.iter) for gen in node.generators
+            ) or self.expr_tainted(node.elt)
+        if isinstance(node, ast.JoinedStr):
+            return False
+        # Unknown expression shape: assume traced (conservative for
+        # branching, which is the dangerous direction).
+        return any(
+            self.expr_tainted(c)
+            for c in ast.iter_child_nodes(node)
+            if isinstance(c, ast.expr)
+        )
+
+    def call_tainted(self, node: ast.Call) -> bool:
+        name = call_name(node)
+        args_tainted = any(self.expr_tainted(a) for a in node.args) or any(
+            self.expr_tainted(kw.value) for kw in node.keywords
+        )
+        if name in STATIC_CALLS:
+            return False
+        # Same-module helper: recurse with call-site taint for an
+        # accurate return taint (and to scan the helper's own body).
+        helper = self.defs.get(name) if isinstance(node.func, ast.Name) else None
+        if helper is not None and self.depth < MAX_HELPER_DEPTH:
+            return self._analyze_helper(helper, node)
+        if isinstance(node.func, ast.Attribute):
+            # Method on a traced value (x.sum(), x.astype()...) stays
+            # traced; method on an untraced receiver with untraced
+            # args is host-side.
+            return self.expr_tainted(node.func.value) or args_tainted
+        return args_tainted
+
+    # -- statement walk ------------------------------------------------------
+
+    def flag(self, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(PASS_ID, self.pf.rel, node.lineno, message)
+        )
+
+    def check_host_sync(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name in HOST_CONVERSIONS and isinstance(node.func, ast.Name):
+            if node.args and self.expr_tainted(node.args[0]):
+                self.flag(node, (
+                    f"host sync in jit code: {name}() forces a "
+                    f"device→host transfer of a traced value"
+                ))
+            return
+        if name in HOST_METHODS and isinstance(node.func, ast.Attribute):
+            if self.expr_tainted(node.func.value):
+                self.flag(node, (
+                    f"host sync in jit code: .{name}() on a traced value"
+                ))
+            return
+        if name == "device_get":
+            self.flag(node, "host sync in jit code: jax.device_get()")
+            return
+        if name in HOST_NP_FUNCS and isinstance(node.func, ast.Attribute):
+            chain = attr_chain(node.func)
+            if chain is not None and chain[0] in ("np", "numpy"):
+                if any(self.expr_tainted(a) for a in node.args):
+                    self.flag(node, (
+                        f"host sync in jit code: np.{name}() on a "
+                        f"traced value materializes it on the host"
+                    ))
+
+    def walk(self, stmts: Sequence[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = stmt.value
+                if value is not None:
+                    self.scan_calls(value)
+                    tainted = self.expr_tainted(value)
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign) else [stmt.target]
+                    )
+                    for target in targets:
+                        self.assign_taint(target, tainted)
+            elif isinstance(stmt, ast.If):
+                self.scan_calls(stmt.test)
+                if self.expr_tainted(stmt.test):
+                    self.flag(stmt, (
+                        "python branch on a traced value in jit code "
+                        "(`if` over a tracer; use jnp.where / lax.cond)"
+                    ))
+                self.walk(stmt.body)
+                self.walk(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                self.scan_calls(stmt.test)
+                if self.expr_tainted(stmt.test):
+                    self.flag(stmt, (
+                        "python loop condition on a traced value in jit "
+                        "code (use lax.while_loop)"
+                    ))
+                self.walk(stmt.body)
+                self.walk(stmt.orelse)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self.scan_calls(stmt.iter)
+                if self.expr_tainted(stmt.iter):
+                    self.flag(stmt, (
+                        "python iteration over a traced value in jit "
+                        "code (use lax.fori_loop / scan)"
+                    ))
+                self.assign_taint(stmt.target, False)
+                self.walk(stmt.body)
+                self.walk(stmt.orelse)
+            elif isinstance(stmt, ast.Assert):
+                self.scan_calls(stmt.test)
+                if self.expr_tainted(stmt.test):
+                    self.flag(stmt, (
+                        "assert on a traced value in jit code (checks "
+                        "nothing once traced; use checkify or a static "
+                        "shape assert)"
+                    ))
+            elif isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    self.scan_calls(stmt.value)
+                    if self.expr_tainted(stmt.value):
+                        self.returns_tainted = True
+            elif isinstance(stmt, ast.Expr):
+                self.scan_calls(stmt.value)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self.scan_calls(item.context_expr)
+                self.walk(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self.walk(stmt.body)
+                for handler in stmt.handlers:
+                    self.walk(handler.body)
+                self.walk(stmt.orelse)
+                self.walk(stmt.finalbody)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested def: analyzed when called (helper path); its
+                # free variables share this scope's taint, which the
+                # helper analysis approximates via call-site args.
+                continue
+            # remaining statements: no taint flow we track
+
+    def scan_calls(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self.check_host_sync(node)
+
+    def assign_taint(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.assign_taint(elt, tainted)
+        # attribute/subscript writes: no name-level taint to track
+
+    def _analyze_helper(self, helper: ast.AST, call: ast.Call) -> bool:
+        params = [a.arg for a in helper.args.args]
+        arg_taint: Set[str] = set()
+        for i, arg in enumerate(call.args):
+            if i < len(params) and self.expr_tainted(arg):
+                arg_taint.add(params[i])
+        for kw in call.keywords:
+            if kw.arg in params and self.expr_tainted(kw.value):
+                arg_taint.add(kw.arg)
+        key = (id(helper), frozenset(arg_taint))
+        if key in self.memo:
+            return self.memo[key]
+        self.memo[key] = True  # cycle guard: assume tainted while open
+        sub = _TaintChecker(self.pf, self.defs, self.findings, self.memo,
+                            self.depth + 1)
+        sub.tainted = set(arg_taint)
+        sub.walk(helper.body)
+        self.memo[key] = sub.returns_tainted
+        return sub.returns_tainted
+
+
+def _check_donated_reuse(pf: ProjectFile,
+                         registered: Dict[str, JitRoot],
+                         findings: List[Finding]) -> None:
+    donating = {
+        name: root.donate_argnums
+        for name, root in registered.items() if root.donate_argnums
+    }
+    if not donating:
+        return
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        _scan_donated_in_function(pf, node, donating, findings)
+
+
+def _scan_donated_in_function(pf, func, donating, findings) -> None:
+    # Statement-order scan: after `r = jitted(buf, ...)` with buf in a
+    # donated position, a later read of `buf` (before reassignment) is
+    # a use of freed/aliased device memory.
+    donated_vars: Dict[str, int] = {}  # name -> donation line
+
+    def visit(stmts):
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name
+                ) and node.func.id in donating:
+                    for idx in donating[node.func.id]:
+                        if idx < len(node.args) and isinstance(
+                            node.args[idx], ast.Name
+                        ):
+                            donated_vars[node.args[idx].id] = node.lineno
+            # reads after donation
+            for node in ast.walk(stmt):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in donated_vars
+                    and node.lineno > donated_vars[node.id]
+                ):
+                    findings.append(Finding(
+                        PASS_ID, pf.rel, node.lineno,
+                        f"donated-buffer reuse: {node.id!r} was passed "
+                        f"in a donate_argnums position at line "
+                        f"{donated_vars[node.id]} and read again — the "
+                        f"buffer may already alias the jit output",
+                    ))
+                    del donated_vars[node.id]
+            # reassignment clears the donation
+            if isinstance(stmt, ast.Assign):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        donated_vars.pop(target.id, None)
+
+    visit(func.body)
+
+
+@register_pass(PASS_ID)
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for pf in project.files:
+        roots, registered = _collect_roots(pf)
+        if not roots:
+            continue
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+        memo: Dict[Tuple[int, frozenset], bool] = {}
+        analyzed: Set[int] = set()
+        for root in roots:
+            if id(root.func) in analyzed:
+                continue
+            analyzed.add(id(root.func))
+            checker = _TaintChecker(pf, defs, findings, memo, depth=0)
+            checker.tainted = {
+                a.arg for a in root.func.args.args
+                if a.arg not in root.static_names
+            }
+            checker.walk(root.func.body)
+        _check_donated_reuse(pf, registered, findings)
+    # One finding per (file, line, message): the same helper analyzed
+    # under several taint signatures re-reports identical sites.
+    unique = sorted(set(findings), key=lambda f: (f.file, f.line, f.message))
+    return unique

@@ -1,0 +1,108 @@
+"""kbtlint self-test: a checker that cannot see a violation is
+decoration (same policy as ``tools/bench_compare.py --self-test``).
+
+Runs every pass against known-bad fixture snippets (each must produce
+its finding) and known-good ones (each must come back clean), checks
+the allowlist roundtrip (suppression, stale detection, mandatory
+reasons), and seeds a census violation through the comparison logic.
+Run via ``python -m tools.kbtlint --self-test`` (part of
+``make kbtlint``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Tuple
+
+from . import census, core, dirty_ledger, jit_hygiene, lock_order
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+def _fixture_project(name: str) -> core.Project:
+    path = os.path.join(FIXTURES, name)
+    with open(path) as f:
+        return core.load_snippet(f.read(), rel=f"fixtures/{name}")
+
+
+def _expect(findings, substring: str, where: str, failures: List[str]):
+    if not any(substring in f.message for f in findings):
+        failures.append(
+            f"{where}: expected a finding containing {substring!r}, "
+            f"got {[f.render() for f in findings]}"
+        )
+
+
+def _expect_clean(findings, where: str, failures: List[str]):
+    if findings:
+        failures.append(
+            f"{where}: expected no findings, got "
+            f"{[f.render() for f in findings]}"
+        )
+
+
+def run_selftest() -> List[str]:
+    """Returns a list of failure descriptions (empty = pass)."""
+    failures: List[str] = []
+
+    cases: List[Tuple[Callable, str, str]] = [
+        (lock_order.run, "lock_cycle_bad.py", "lock-order cycle"),
+        (lock_order.run, "fence_mutex_bad.py", "leaf-lock violation"),
+        (lock_order.run, "mutex_blocking_bad.py", "blocking call"),
+        (lock_order.run, "mutex_blocking_bad.py", "join()"),
+        (dirty_ledger.run, "ledger_bad.py", "unstamped allocation"),
+        (jit_hygiene.run, "jit_bad.py", "branch on a traced value"),
+        (jit_hygiene.run, "jit_bad.py", "host sync"),
+        (jit_hygiene.run, "jit_bad.py", "donated-buffer reuse"),
+    ]
+    for pass_fn, fixture, substring in cases:
+        findings = pass_fn(_fixture_project(fixture))
+        _expect(findings, substring, fixture, failures)
+
+    for pass_fn, fixture in [
+        (lock_order.run, "lock_good.py"),
+        (dirty_ledger.run, "ledger_good.py"),
+        (jit_hygiene.run, "jit_good.py"),
+    ]:
+        _expect_clean(pass_fn(_fixture_project(fixture)), fixture, failures)
+
+    # Allowlist roundtrip: covers, suppresses, flags stale.
+    finding = core.Finding("lock-order", "fixtures/x.py", 3, "cycle: a <-> b")
+    entry = core.AllowEntry(
+        pass_id="lock-order", file="fixtures/x.py", match="cycle",
+        reason="selftest",
+    )
+    kept, suppressed, stale = core.apply_allowlist([finding], [entry])
+    if kept or not suppressed or stale:
+        failures.append("allowlist: matching entry failed to suppress")
+    kept, suppressed, stale = core.apply_allowlist([], [core.AllowEntry(
+        pass_id="census", file="nope.md", match="zzz", reason="selftest",
+    )])
+    if not stale:
+        failures.append("allowlist: stale entry not detected")
+
+    # Seeded census violations: an uncensused env var and a stale doc
+    # row must both surface.
+    doc_names, doc_line = census.read_marked_table(
+        census.CONFIG_DOC, "env-vars"
+    )
+    if doc_names is None:
+        failures.append("census: env-vars table marker missing in "
+                        f"{census.CONFIG_DOC}")
+    else:
+        seeded = census.compare_census(
+            "KBT env-var",
+            set(doc_names) | {"KBT_KBTLINT_SELFTEST_ONLY"},
+            doc_names, census.CONFIG_DOC, doc_line,
+        )
+        _expect(seeded, "KBT_KBTLINT_SELFTEST_ONLY", "census-seeded",
+                failures)
+        seeded = census.compare_census(
+            "KBT env-var",
+            set(doc_names) - {sorted(doc_names)[0]} if doc_names else set(),
+            doc_names, census.CONFIG_DOC, doc_line,
+        )
+        _expect(seeded, "stale row", "census-stale-seeded", failures)
+
+    return failures

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Strict-mode type-check baseline over the solver + cache layers
+(``make typecheck``; doc/design/static-analysis.md).
+
+The container carries no third-party type checker, so this driver
+degrades explicitly instead of silently:
+
+- **mypy installed** → ``mypy --strict`` over the targets; errors are
+  counted per file.
+- **otherwise** → a stdlib *annotation audit*: every public function/
+  method (name not ``_``-prefixed, not a dunder) in the targets is
+  checked for missing parameter and return annotations — the
+  machine-checkable core of "strict mode" that needs no inference
+  engine.
+
+Either way the counts are held to the committed suppression ledger
+``tools/typecheck_baseline.json`` with **ratchet semantics**:
+
+- a file's count above its baseline → FAIL, listing the new findings;
+- a file's count below its baseline → FAIL with "bank the progress"
+  (run ``--update-baseline``) — a ratchet that can silently loosen is
+  no ratchet;
+- the ledger records which tool produced it; a different tool at run
+  time skips loudly (exit 0) rather than comparing apples to oranges.
+
+Exit codes: 0 in-baseline (or tool-mismatch skip), 1 ratchet
+violation, 2 internal error.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import shutil
+import subprocess
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO, "tools", "typecheck_baseline.json")
+TARGETS = ("kube_batch_tpu/solver", "kube_batch_tpu/cache")
+
+
+def iter_py_files():
+    for target in TARGETS:
+        root = os.path.join(REPO, target)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [
+                d for d in dirnames if d not in ("__pycache__", "csrc")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+# -- stdlib annotation audit -------------------------------------------------
+
+
+def audit_file(path: str) -> List[Tuple[int, str]]:
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    findings: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name.startswith("_"):
+            continue  # private/dunder: out of the public contract
+        args = node.args
+        params = args.posonlyargs + args.args + args.kwonlyargs
+        for i, arg in enumerate(params):
+            if arg.arg in ("self", "cls") and i == 0:
+                continue
+            if arg.annotation is None:
+                findings.append((
+                    node.lineno,
+                    f"{node.name}: parameter {arg.arg!r} missing "
+                    f"annotation",
+                ))
+        if node.returns is None:
+            findings.append(
+                (node.lineno, f"{node.name}: missing return annotation")
+            )
+    return findings
+
+
+def run_stdlib_audit() -> Dict[str, List[Tuple[int, str]]]:
+    out: Dict[str, List[Tuple[int, str]]] = {}
+    for path in iter_py_files():
+        rel = os.path.relpath(path, REPO)
+        findings = audit_file(path)
+        if findings:
+            out[rel] = findings
+    return out
+
+
+# -- mypy --------------------------------------------------------------------
+
+
+def run_mypy() -> Dict[str, List[Tuple[int, str]]]:
+    cmd = [
+        sys.executable, "-m", "mypy", "--strict", "--no-error-summary",
+        "--no-color-output",
+    ] + [os.path.join(REPO, t) for t in TARGETS]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=REPO, timeout=600
+    )
+    out: Dict[str, List[Tuple[int, str]]] = {}
+    for line in proc.stdout.splitlines():
+        # path:line: error: message
+        parts = line.split(":", 3)
+        if len(parts) < 4 or "error" not in parts[2]:
+            continue
+        rel = os.path.relpath(os.path.join(REPO, parts[0]), REPO)
+        try:
+            lineno = int(parts[1])
+        except ValueError:
+            continue
+        out.setdefault(rel, []).append((lineno, parts[3].strip()))
+    return out
+
+
+def detect_tool() -> str:
+    try:
+        import mypy  # noqa: F401
+
+        return "mypy-strict"
+    except ImportError:
+        pass
+    if shutil.which("mypy"):
+        return "mypy-strict"
+    return "stdlib-annotations"
+
+
+def main(argv=None) -> int:
+    update = "--update-baseline" in (argv or sys.argv[1:])
+    tool = detect_tool()
+    findings = run_mypy() if tool == "mypy-strict" else run_stdlib_audit()
+    counts = {rel: len(items) for rel, items in findings.items()}
+
+    if update:
+        baseline = {
+            "tool": tool,
+            "note": (
+                "Suppression ledger for `make typecheck` (ratchet: "
+                "per-file counts may only go DOWN, and a decrease must "
+                "be re-banked here via --update-baseline). Entries are "
+                "pre-existing debt, suppressed so the gate can hold "
+                "NEW code strict without a big-bang annotation PR."
+            ),
+            "files": dict(sorted(counts.items())),
+        }
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=False)
+            f.write("\n")
+        total = sum(counts.values())
+        print(f"typecheck: baseline updated ({tool}, {total} suppressed "
+              f"finding(s) across {len(counts)} file(s))")
+        return 0
+
+    if not os.path.exists(BASELINE_PATH):
+        print(
+            "typecheck: no baseline committed — run "
+            "`python tools/typecheck.py --update-baseline`",
+            file=sys.stderr,
+        )
+        return 1
+    with open(BASELINE_PATH) as f:
+        baseline = json.load(f)
+    if baseline.get("tool") != tool:
+        print(
+            f"typecheck: SKIPPED — baseline was produced by "
+            f"{baseline.get('tool')!r} but this environment has {tool!r}; "
+            f"re-bank with --update-baseline to switch tools",
+        )
+        return 0
+
+    base_counts: Dict[str, int] = baseline.get("files", {})
+    failures = 0
+    for rel in sorted(set(counts) | set(base_counts)):
+        have = counts.get(rel, 0)
+        allowed = base_counts.get(rel, 0)
+        if have > allowed:
+            failures += 1
+            print(f"{rel}: {have} finding(s), baseline allows {allowed} — "
+                  f"new type debt:")
+            for lineno, msg in sorted(findings.get(rel, []))[:20]:
+                print(f"  {rel}:{lineno}: {msg}")
+        elif have < allowed:
+            failures += 1
+            print(
+                f"{rel}: {have} finding(s), baseline allows {allowed} — "
+                f"progress! bank it: python tools/typecheck.py "
+                f"--update-baseline"
+            )
+    total = sum(counts.values())
+    print(
+        f"typecheck ({tool}): {total} finding(s) across "
+        f"{len(counts)} file(s), baseline "
+        f"{sum(base_counts.values())} — "
+        f"{'RATCHET VIOLATION' if failures else 'in baseline'}",
+        file=sys.stderr,
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
