@@ -56,9 +56,13 @@ bench-smoke:
 # failures + node flaps + an injected cycle crash) through the REAL
 # scheduler/cache/actions stack; the CLI exits nonzero on ANY invariant
 # violation (oversubscription, split gang, lost/double-bound task,
-# fair-share breach). doc/design/simulator.md.
+# fair-share breach). doc/design/simulator.md. KBT_CHECK_CONTRACTS=1
+# arms the runtime tensor shape/dtype contract validation
+# (solver/contracts.py — the twin of kbtlint's shape-contracts pass) at
+# the tensorize and device-pack choke points.
 sim-smoke:
-	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim --cycles 120 --seed 7 \
+	env $(CPU_ENV) KBT_CHECK_CONTRACTS=1 $(PY) -m kube_batch_tpu sim \
+		--cycles 120 --seed 7 \
 		--faults "bind:0.05,node-flap:0.02,crash:0.02" \
 		--node-churn 0.03 --quiet
 
@@ -77,12 +81,14 @@ soak-smoke:
 # default-route — absorbs the injected solver exceptions/hangs). The
 # CLI exits 1 on any invariant violation and 3 on any cycle error
 # (--fail-on-cycle-errors): a wedge or an uncontained device fault
-# fails the build. doc/design/robustness.md. KBT_LOCK_DEBUG=1 arms the
-# order-asserting lock proxies (utils/lockdebug.py) — a lock-order
-# violation anywhere in the storm raises with both acquisition
-# tracebacks and fails the cycle (doc/design/static-analysis.md).
+# fails the build. doc/design/robustness.md. KBT_LOCK_DEBUG=2 arms the
+# order-asserting lock proxies (utils/lockdebug.py) AND the
+# guarded-write witness — a lock-order violation anywhere in the storm
+# raises with both acquisition tracebacks, and a registered
+# lock-guarded attribute written without its lock raises with the
+# writing site; either fails the cycle (doc/design/static-analysis.md).
 chaos-smoke:
-	env $(CPU_ENV) KBT_LOCK_DEBUG=1 $(PY) -m kube_batch_tpu sim \
+	env $(CPU_ENV) KBT_LOCK_DEBUG=2 $(PY) -m kube_batch_tpu sim \
 		--cycles 250 --seed 11 \
 		--backend dense \
 		--faults "solver-exc:0.08,solver-hang:0.02,bind:0.05" \
@@ -95,7 +101,7 @@ chaos-smoke:
 # solver faults on the micro path too, and the invariant checker runs
 # every cycle — exit 1 on any violation, 3 on any cycle error.
 micro-smoke:
-	env $(CPU_ENV) KBT_LOCK_DEBUG=1 $(PY) -m kube_batch_tpu sim \
+	env $(CPU_ENV) KBT_LOCK_DEBUG=2 $(PY) -m kube_batch_tpu sim \
 		--cycles 250 --seed 11 \
 		--backend dense --micro-every 4 \
 		--faults "solver-exc:0.08,solver-hang:0.02,bind:0.05" \
@@ -127,15 +133,18 @@ verify:
 
 # Project-invariant static analysis (doc/design/static-analysis.md):
 # lock-order graph (cycles, fence-leaf rule, blocking work under
-# cache.mutex), dirty-ledger completeness, jit hygiene, and the
-# doc<->code censuses (metrics / KBT_* env vars / flight-record keys /
-# /debug/vars keys — exact, both directions). Findings fail the build
-# unless allowlisted WITH a reason (tools/kbtlint/allowlist.json;
-# stale entries fail too). Then the self-test: a seeded violation of
-# every pass must flip the exit code — a checker that cannot see a
-# violation is decoration.
+# cache.mutex), dirty-ledger completeness, jit hygiene, guarded-by
+# lock-ownership inference, replay-determinism taint, solver tensor
+# shape/dtype contracts, and the doc<->code censuses (metrics / KBT_*
+# env vars / flight-record keys / /debug/vars keys — exact, both
+# directions). Findings fail the build unless allowlisted WITH a
+# reason (tools/kbtlint/allowlist.json; stale entries fail too). The
+# wall-clock budget fails the build if the full run crawls past 5 s —
+# a new pass must not silently tax every CI run. Then the self-test: a
+# seeded violation of every pass must flip the exit code — a checker
+# that cannot see a violation is decoration.
 kbtlint:
-	$(PY) -m tools.kbtlint
+	$(PY) -m tools.kbtlint --budget-seconds 5
 	$(PY) -m tools.kbtlint --self-test
 
 # Strict-mode type-check baseline over solver/ + cache/ with a

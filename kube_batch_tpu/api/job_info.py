@@ -341,7 +341,9 @@ class JobInfo:
                 self.allocated.add(task.resreq)
             task.status = status
             target[task.uid] = task
-        for src_status in sources:
+        # Sorted: bucket-deletion order must not depend on set-hash
+        # order (kbtlint replay-determinism; TaskStatus is an IntEnum).
+        for src_status in sorted(sources):
             bucket = self.task_status_index.get(src_status)
             if bucket is not None and not bucket:
                 del self.task_status_index[src_status]

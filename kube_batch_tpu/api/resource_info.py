@@ -372,7 +372,12 @@ def min_resource(l: Resource, r: Resource) -> Resource:
     out = Resource.empty()
     out.milli_cpu = min(l.milli_cpu, r.milli_cpu)
     out.memory = min(l.memory, r.memory)
-    for name in set(l.scalar_resources or {}) | set(r.scalar_resources or {}):
+    # Sorted so the scalar dict's insertion order is byte-stable across
+    # processes (kbtlint replay-determinism: string set order is hash-
+    # randomized, and a downstream layout iterating it would drift).
+    for name in sorted(
+        set(l.scalar_resources or {}) | set(r.scalar_resources or {})
+    ):
         out.set_scalar(name, min(l.get(name), r.get(name)))
     return out
 

@@ -42,7 +42,7 @@ from ..api import (
 from ..obs.tracer import TRACER, span as _obs_span
 from ..api.objects import DEFAULT_SCHEDULER_NAME
 from ..cluster import ADDED, DELETED, MODIFIED, ClusterAPI
-from ..utils.lockdebug import wrap_lock
+from ..utils.lockdebug import witness_writes, wrap_lock
 from .event_handlers import EventHandlersMixin
 from .interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
 from .util import job_terminated, shadow_pod_group
@@ -286,6 +286,25 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self._fence_lock = wrap_lock("cache.fence_lock")
         self._fence_refusals = 0
 
+        # KBT_LOCK_DEBUG=2 write-witness (no-op otherwise): the runtime
+        # twin of kbtlint's guarded-by pass, per named lock. Attribute
+        # REBINDS only — item mutations of the mirror maps are covered
+        # by the dirty-ledger pass + fingerprint verification.
+        witness_writes(self, "cache.mutex", (
+            "jobs", "nodes", "queues", "priority_classes",
+            "default_priority", "default_priority_class", "_priority_gen",
+            "_snap_gen", "_snap_pool", "_last_snap_jobs",
+            "_last_snap_nodes", "_snap_total_allocatable", "_snap_fp",
+            "_snap_fp_priority_gen", "_full_backlog_jobs",
+            "_full_backlog_nodes",
+        ))
+        witness_writes(self, "cache.fence_lock", (
+            "_fence_reason", "_fence_refusals",
+        ))
+        witness_writes(self, "cache.inflight_cond", (
+            "_inflight", "_bookkeeping_inflight",
+        ))
+
     # -- leadership fencing ---------------------------------------------------
 
     def fence(self, reason: str) -> None:
@@ -384,10 +403,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
     def wait_for_bookkeeping(self, timeout: float = 60.0) -> bool:
         """Block until every deferred cache-mirror update (bind_batch
         bookkeeping) has executed."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._inflight_cond:
             while self._bookkeeping_inflight > 0:
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._inflight_cond.wait(remaining)
@@ -395,10 +414,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
 
     def wait_for_side_effects(self, timeout: float = 10.0) -> bool:
         """Block until every queued async bind/evict has executed."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._inflight_cond:
             while self._inflight > 0:
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._inflight_cond.wait(remaining)
@@ -1377,10 +1396,14 @@ class SchedulerCache(Cache, EventHandlersMixin):
 
     # String (reference cache.go String()) omitted; repr is enough.
     def __repr__(self) -> str:
-        return (
-            f"SchedulerCache(jobs={len(self.jobs)}, nodes={len(self.nodes)}, "
-            f"queues={len(self.queues)})"
-        )
+        # Under the mutex: a log line formatting the cache from another
+        # thread must not read the maps mid-mutation (kbtlint
+        # guarded-by; the mutex is reentrant, so repr-while-held works).
+        with self.mutex:
+            return (
+                f"SchedulerCache(jobs={len(self.jobs)}, "
+                f"nodes={len(self.nodes)}, queues={len(self.queues)})"
+            )
 
 
 def new_scheduler_cache(cluster: ClusterAPI, scheduler_name: str, default_queue: str,

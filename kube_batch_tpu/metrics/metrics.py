@@ -151,10 +151,12 @@ class Histogram(_Metric):
             self._totals[labels] = self._totals.get(labels, 0) + int(arr.size)
 
     def count(self, labels: Tuple = ()) -> int:
-        return self._totals.get(labels, 0)
+        with self._lock:
+            return self._totals.get(labels, 0)
 
     def sum(self, labels: Tuple = ()) -> float:
-        return self._sums.get(labels, 0.0)
+        with self._lock:
+            return self._sums.get(labels, 0.0)
 
     def remove(self, labels: Tuple) -> bool:
         """Drop one label set (see Counter.remove)."""
@@ -165,22 +167,30 @@ class Histogram(_Metric):
             return existed
 
     def series_count(self) -> int:
-        return len(self._totals)
+        with self._lock:
+            return len(self._totals)
 
     def expose(self, label_names: Tuple = ()) -> List[str]:
         lines = [f"# TYPE {self.name} histogram"]
-        for labels in sorted(self._totals):
-            base = ",".join(f'{n}="{val}"' for n, val in zip(label_names, labels))
-            for b, c in zip(self.buckets, self._counts[labels]):
-                sel = f'{base},le="{b}"' if base else f'le="{b}"'
-                lines.append(f"{self.name}_bucket{{{sel}}} {c}")
-            inf_sel = f'{base},le="+Inf"' if base else 'le="+Inf"'
-            lines.append(
-                f"{self.name}_bucket{{{inf_sel}}} {self._totals[labels]}"
-            )
-            sel = f"{{{base}}}" if base else ""
-            lines.append(f"{self.name}_sum{sel} {self._sums[labels]}")
-            lines.append(f"{self.name}_count{sel} {self._totals[labels]}")
+        # Under the lock: a scrape iterating the label maps while the
+        # scheduler thread observes (or GC removes a series) is a
+        # dict-changed-during-iteration crash on the HTTP worker
+        # (kbtlint guarded-by bring-up).
+        with self._lock:
+            for labels in sorted(self._totals):
+                base = ",".join(
+                    f'{n}="{val}"' for n, val in zip(label_names, labels)
+                )
+                for b, c in zip(self.buckets, self._counts[labels]):
+                    sel = f'{base},le="{b}"' if base else f'le="{b}"'
+                    lines.append(f"{self.name}_bucket{{{sel}}} {c}")
+                inf_sel = f'{base},le="+Inf"' if base else 'le="+Inf"'
+                lines.append(
+                    f"{self.name}_bucket{{{inf_sel}}} {self._totals[labels]}"
+                )
+                sel = f"{{{base}}}" if base else ""
+                lines.append(f"{self.name}_sum{sel} {self._sums[labels]}")
+                lines.append(f"{self.name}_count{sel} {self._totals[labels]}")
         return lines
 
 

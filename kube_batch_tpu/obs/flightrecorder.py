@@ -32,7 +32,7 @@ import traceback
 from collections import deque
 from typing import List, Optional
 
-from ..utils.lockdebug import wrap_lock
+from ..utils.lockdebug import witness_writes, wrap_lock
 
 logger = logging.getLogger(__name__)
 
@@ -77,6 +77,10 @@ class FlightRecorder:
         self.started_at = time.time()
         self.last_cycle_ts: Optional[float] = None
         self.error_count = 0
+        # KBT_LOCK_DEBUG=2 write-witness (no-op otherwise).
+        witness_writes(self, "obs.flightrecorder", (
+            "_seq", "_open", "last_cycle_ts", "error_count",
+        ))
 
     # -- per-cycle lifecycle ------------------------------------------------
 
@@ -249,8 +253,10 @@ class FlightRecorder:
         directory = directory or os.environ.get(FLIGHT_DIR_ENV)
         if not directory:
             return None
+        with self._lock:
+            seq = self._seq
         path = os.path.join(
-            directory, f"flight-{os.getpid()}-err-{self._seq}.json"
+            directory, f"flight-{os.getpid()}-err-{seq}.json"
         )
         try:
             self.dump_to(path, reason="cycle-error")

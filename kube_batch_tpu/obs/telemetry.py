@@ -39,7 +39,7 @@ import weakref
 from collections import deque
 from typing import Dict, List, Optional
 
-from ..utils.lockdebug import wrap_lock
+from ..utils.lockdebug import witness_writes, wrap_lock
 
 logger = logging.getLogger(__name__)
 
@@ -191,6 +191,14 @@ class Telemetry:
         self._cache_ref = None          # weakref to the fed SchedulerCache
         self._fair_state: dict = {}     # fairness probe memo (node total)
         self.configure(window_cycles, max_windows, raw_capacity)
+        # KBT_LOCK_DEBUG=2 write-witness (no-op otherwise). configure()
+        # re-arms are fine: it writes under the lock.
+        witness_writes(self, "obs.telemetry", (
+            "window_cycles", "max_windows", "raw_capacity", "_raw",
+            "_windows", "_open", "_open_start", "_open_cycles",
+            "cycles_observed", "windows_rolled", "windows_dropped",
+            "_last_cycle",
+        ))
 
     def configure(
         self,
@@ -359,13 +367,19 @@ class Telemetry:
             except Exception:  # pragma: no cover - forensics only
                 logger.exception("fairness probe failed")
         self.observe_values(values)
+        with self._lock:
+            # A concurrent configure() rebinds the rings; snapshot the
+            # watermark inputs under the same lock every other reader
+            # holds (kbtlint guarded-by).
+            raw_occupancy = len(self._raw)
+            windows_rolled = self.windows_rolled
         try:
             from .. import metrics
 
             metrics.update_telemetry_watermarks(
                 values,
-                raw_occupancy=len(self._raw),
-                windows_rolled=self.windows_rolled,
+                raw_occupancy=raw_occupancy,
+                windows_rolled=windows_rolled,
                 fairness_ran=fairness_ran,
             )
         except Exception:  # pragma: no cover - metrics must never kill

@@ -20,7 +20,7 @@ from .framework import Action, close_session, get_action, open_session
 from .obs import RECORDER, export_trace, span
 from .obs.tracer import TRACER, maybe_enable_from_env
 from .utils import deferred_gc
-from .utils.lockdebug import wrap_lock
+from .utils.lockdebug import witness_writes, wrap_lock
 
 logger = logging.getLogger(__name__)
 
@@ -66,6 +66,12 @@ class LoopWatchdog:
         self._tripped_cycle: Optional[int] = None
         self._thread: Optional[threading.Thread] = None
         self._stop: Optional[threading.Event] = None
+        # KBT_LOCK_DEBUG=2 write-witness (no-op otherwise). _thread/
+        # _stop stay out: start() runs once before the thread exists.
+        witness_writes(self, "scheduler.watchdog", (
+            "_inflight_since", "_inflight_cycle", "_tripped_cycle",
+            "trips", "last_trip",
+        ))
 
     def cycle_begin(self, cycle: int) -> None:
         with self._lock:

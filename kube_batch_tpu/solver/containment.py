@@ -47,7 +47,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ..utils.lockdebug import wrap_lock
+from ..utils.lockdebug import witness_writes, wrap_lock
 
 logger = logging.getLogger(__name__)
 
@@ -269,6 +269,13 @@ class CircuitBreaker:
         self._cooldown_left = 0
         self._opened_ts: Optional[float] = None
         self._pinned_reason: Optional[str] = None
+        # KBT_LOCK_DEBUG=2 write-witness: every transition field is
+        # lock-guarded by contract (no-op below level 2).
+        witness_writes(self, "solver.breaker", (
+            "state", "failure_streak", "trips", "reclosures",
+            "probes_ok", "probes_failed", "last_failure",
+            "_cooldown_left", "_opened_ts", "_pinned_reason",
+        ))
 
     # -- transitions (callers hold no lock) ----------------------------------
 

@@ -1,0 +1,211 @@
+"""Tensor shape/dtype contracts for the solver input bundles.
+
+One table, two consumers:
+
+- ``tools/kbtlint``'s ``shape-contracts`` pass parses this file by AST
+  (the tables below must stay pure literals) and checks it against the
+  code: NamedTuple field censuses both directions, the per-field
+  ``# dtype[shape]`` comment contracts in kernels.py, the device-cache
+  row-axis/donation map, the tensorize producer dict, and constant
+  stack indexing (``task_i32[7]`` against a declared ``[6, T]`` stack
+  is a build failure, not a runtime shape error three layers later);
+- the runtime twin below (:func:`validate_solver_inputs` /
+  :func:`validate_packed`) checks REAL arrays against the same table —
+  symbolic dims are bound across fields (every ``T`` must agree) —
+  armed by ``KBT_CHECK_CONTRACTS=1`` at the two producer choke points
+  (tensorize's host bundle, device_cache.pack) and called directly by
+  the unit tests.
+
+Symbols: ``T`` pending tasks, ``N`` nodes, ``R`` resource dims, ``Q``
+queues, ``G`` feasibility groups, ``P`` private-row tasks, ``S``
+static-score rows, ``C`` candidate classes, ``K`` top-K candidate
+width. Integer entries are exact stack heights. ``"R+2"``-style
+entries check once the base symbol is bound. A new field (e.g. item
+1's sharded-sparse slabs) MUST land here first — the lint fails the
+build on an undeclared field either direction.
+
+``row_axis`` is the axis along which cycle deltas are row-shaped —
+must match ``device_cache._ROW_AXIS`` exactly. ``donated: True``
+records that the field's resident device buffer is donated by the
+patch path (deleted under any holder on the next pack; the
+device-cache OWNERSHIP contract).
+
+Stdlib+numpy only: importable before jax, parseable without importing.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# -- declaration tables (pure literals: the lint evals them by AST) ----------
+
+SOLVER_INPUT_CONTRACTS = {
+    "task_req":        {"shape": ["T", "R"], "dtype": "f32"},
+    "task_fit":        {"shape": ["T", "R"], "dtype": "f32"},
+    "task_rank":       {"shape": ["T"], "dtype": "i32"},
+    "task_job":        {"shape": ["T"], "dtype": "i32"},
+    "task_queue":      {"shape": ["T"], "dtype": "i32"},
+    "task_valid":      {"shape": ["T"], "dtype": "bool"},
+    "task_group":      {"shape": ["T"], "dtype": "i32"},
+    "node_feas":       {"shape": ["N"], "dtype": "bool"},
+    "group_feas":      {"shape": ["G", "N"], "dtype": "bool"},
+    "pair_idx":        {"shape": ["P"], "dtype": "i32"},
+    "pair_feas":       {"shape": ["P", "N"], "dtype": "bool"},
+    "score_idx":       {"shape": ["S"], "dtype": "i32"},
+    "score_rows":      {"shape": ["S", "N"], "dtype": "f32"},
+    "node_idle":       {"shape": ["N", "R"], "dtype": "f32"},
+    "node_releasing":  {"shape": ["N", "R"], "dtype": "f32"},
+    "node_cap":        {"shape": ["N", "R"], "dtype": "f32"},
+    "node_task_count": {"shape": ["N"], "dtype": "i32"},
+    "node_max_tasks":  {"shape": ["N"], "dtype": "i32"},
+    "queue_deserved":  {"shape": ["Q", "R"], "dtype": "f32"},
+    "queue_allocated": {"shape": ["Q", "R"], "dtype": "f32"},
+    "eps":             {"shape": ["R"], "dtype": "f32"},
+    "lr_weight":       {"shape": [], "dtype": "f32"},
+    "br_weight":       {"shape": [], "dtype": "f32"},
+    # Top-K candidate slabs (solver/topk.py); optional — None = dense.
+    "task_cand":       {"shape": ["T"], "dtype": "i32", "optional": True},
+    "cand_idx":        {"shape": ["C", "K"], "dtype": "i32",
+                        "optional": True},
+    "cand_static":     {"shape": ["C", "K"], "dtype": "f32",
+                        "optional": True},
+    "cand_info":       {"shape": [3, "C"], "dtype": "i32",
+                        "optional": True},
+}
+
+PACKED_INPUT_CONTRACTS = {
+    "task_f32":    {"shape": [2, "T", "R"], "dtype": "f32",
+                    "row_axis": 1, "donated": True},
+    "task_i32":    {"shape": [6, "T"], "dtype": "i32",
+                    "row_axis": 1, "donated": True},
+    "node_f32":    {"shape": [3, "N", "R"], "dtype": "f32",
+                    "row_axis": 1, "donated": True},
+    "node_i32":    {"shape": [3, "N"], "dtype": "i32",
+                    "row_axis": 1, "donated": True},
+    "group_feas":  {"shape": ["G", "N"], "dtype": "bool",
+                    "row_axis": 0, "donated": True},
+    "pair_idx":    {"shape": ["P"], "dtype": "i32",
+                    "row_axis": 0, "donated": True},
+    "pair_feas":   {"shape": ["P", "N"], "dtype": "bool",
+                    "row_axis": 0, "donated": True},
+    "score_idx":   {"shape": ["S"], "dtype": "i32",
+                    "row_axis": 0, "donated": True},
+    "score_rows":  {"shape": ["S", "N"], "dtype": "f32",
+                    "row_axis": 0, "donated": True},
+    "queue_f32":   {"shape": [2, "Q", "R"], "dtype": "f32",
+                    "row_axis": 1, "donated": True},
+    "misc":        {"shape": ["R+2"], "dtype": "f32",
+                    "row_axis": 0, "donated": True},
+    "cand_idx":    {"shape": ["C", "K"], "dtype": "i32",
+                    "row_axis": 0, "donated": True, "optional": True},
+    "cand_static": {"shape": ["C", "K"], "dtype": "f32",
+                    "row_axis": 0, "donated": True, "optional": True},
+    "cand_info":   {"shape": [3, "C"], "dtype": "i32",
+                    "row_axis": 1, "donated": True, "optional": True},
+}
+
+CHECK_CONTRACTS_ENV = "KBT_CHECK_CONTRACTS"
+
+_DTYPE_NAMES = {
+    "f32": ("float32",),
+    "f64": ("float64",),
+    "i32": ("int32",),
+    "bool": ("bool", "bool_"),
+}
+
+
+class ContractViolation(AssertionError):
+    """A produced array disagrees with its declared shape/dtype
+    contract (or two fields disagree on a shared symbolic dim)."""
+
+
+def contracts_enabled() -> bool:
+    return os.environ.get(CHECK_CONTRACTS_ENV, "0") == "1"
+
+
+def _check_dim(field: str, i: int, sym, size: int,
+               bound: Dict[str, int], errors: list) -> None:
+    if isinstance(sym, int):
+        if size != sym:
+            errors.append(
+                f"{field}: dim {i} is {size}, contract pins {sym}"
+            )
+        return
+    if "+" in sym:
+        base, _, off = sym.partition("+")
+        if base in bound and size != bound[base] + int(off):
+            errors.append(
+                f"{field}: dim {i} is {size}, contract {sym} = "
+                f"{bound[base] + int(off)} (with {base}={bound[base]})"
+            )
+        return
+    if sym in bound:
+        if size != bound[sym]:
+            errors.append(
+                f"{field}: dim {i} ({sym}) is {size}, but {sym} was "
+                f"bound to {bound[sym]} by an earlier field"
+            )
+    else:
+        bound[sym] = size
+
+
+def _validate(arrays, table, where: str,
+              bound: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+    bound = dict(bound or {})
+    errors: list = []
+    for field, contract in table.items():
+        arr = arrays.get(field)
+        if arr is None:
+            if not contract.get("optional"):
+                errors.append(f"{field}: missing (contract is mandatory)")
+            continue
+        shape = contract["shape"]
+        arr_shape = tuple(getattr(arr, "shape", ()))
+        if len(arr_shape) != len(shape):
+            errors.append(
+                f"{field}: ndim {len(arr_shape)} (shape {arr_shape}), "
+                f"contract declares {shape}"
+            )
+            continue
+        dtype = getattr(arr, "dtype", None)
+        want = _DTYPE_NAMES[contract["dtype"]]
+        if dtype is not None and getattr(dtype, "name", str(dtype)) not in want:
+            errors.append(
+                f"{field}: dtype {dtype}, contract declares "
+                f"{contract['dtype']}"
+            )
+        for i, sym in enumerate(shape):
+            _check_dim(field, i, sym, arr_shape[i], bound, errors)
+    extra = set(arrays) - set(table)
+    for field in sorted(extra):
+        errors.append(
+            f"{field}: produced but not declared in the contract table "
+            f"(add it to solver/contracts.py first)"
+        )
+    if errors:
+        raise ContractViolation(
+            f"solver tensor contract violation(s) at {where}:\n  "
+            + "\n  ".join(errors)
+        )
+    return bound
+
+
+def validate_packed(arrays: Dict[str, object],
+                    where: str = "pack") -> Dict[str, int]:
+    """Check a producer's stacked-array dict against
+    :data:`PACKED_INPUT_CONTRACTS`; returns the symbolic-dim binding.
+    Raises :class:`ContractViolation` listing every disagreement."""
+    return _validate(arrays, PACKED_INPUT_CONTRACTS, where)
+
+
+def validate_solver_inputs(inputs, where: str = "tensorize") -> Dict[str, int]:
+    """Check a ``SolverInputs`` bundle (NumPy or device arrays) against
+    :data:`SOLVER_INPUT_CONTRACTS`."""
+    arrays = {
+        field: getattr(inputs, field, None)
+        for field in SOLVER_INPUT_CONTRACTS
+    }
+    # 0-d scalars may arrive as python floats on hand-built bundles;
+    # the shape/dtype accessors no-op on those.
+    return _validate(arrays, SOLVER_INPUT_CONTRACTS, where)

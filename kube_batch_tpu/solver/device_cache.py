@@ -54,6 +54,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..utils.lockdebug import wrap_lock
+from .contracts import contracts_enabled, validate_packed
 
 logger = logging.getLogger(__name__)
 
@@ -213,6 +214,12 @@ class DeviceSnapshotCache:
         per-cycle forensics in :data:`last_pack_stats` and exports the
         aggregate counters through ``metrics``."""
         from .kernels import PackedInputs
+
+        if contracts_enabled():
+            # Runtime twin of the kbtlint shape-contracts pass: every
+            # stacked buffer against the declaration table, symbolic
+            # dims bound across fields (KBT_CHECK_CONTRACTS=1).
+            validate_packed(arrays, where="device_cache.pack")
 
         stats = {
             "reuses": 0,

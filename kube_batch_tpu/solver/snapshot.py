@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils.lockdebug import wrap_lock
+from .contracts import contracts_enabled, validate_solver_inputs
 
 from ..api import (
     JobInfo,
@@ -1135,6 +1136,11 @@ def tensorize(
         cand_static=cand_static,
         cand_info=cand_info,
     )
+    if contracts_enabled():
+        # Runtime twin of the kbtlint shape-contracts pass
+        # (KBT_CHECK_CONTRACTS=1): the host bundle against the
+        # declaration table before anything downstream consumes it.
+        validate_solver_inputs(host_inputs, where="tensorize")
     ctx = SnapshotContext(
         layout, tasks, nodes, queue_order, mask,
         task_fit_host=fit_mat[order], task_req_host=req_mat[order],

@@ -189,7 +189,9 @@ def plan_warm(ssn) -> Tuple[str, List]:
 
     if carried:
         quids = {obj.queue for (obj, _v, _r) in carried.values()}
-        for quid in quids:
+        # Sorted: the budget re-check must walk queues in a replay-
+        # stable order (kbtlint replay-determinism).
+        for quid in sorted(quids):
             queue = ssn.queues.get(quid)
             cur = _deserved_of(ssn, queue) if queue is not None else None
             if not _res_eq(cur, ws.queue_deserved.get(quid)):

@@ -1,10 +1,11 @@
-"""Runtime lock-order race harness (``KBT_LOCK_DEBUG=1``).
+"""Runtime lock-order race harness (``KBT_LOCK_DEBUG=1``) and
+guarded-write witness (``KBT_LOCK_DEBUG=2``).
 
-The static half of the story — ``tools/kbtlint``'s lock-order pass —
-proves ordering over the acquisition sites it can resolve; this module
-asserts it over the acquisitions that actually HAPPEN. With
-``KBT_LOCK_DEBUG=1`` the project's named locks are wrapped in
-order-asserting proxies:
+The static half of the story — ``tools/kbtlint``'s lock-order and
+guarded-by passes — proves ordering and lock ownership over the sites
+it can resolve; this module asserts them over the acquisitions and
+writes that actually HAPPEN. With ``KBT_LOCK_DEBUG=1`` the project's
+named locks are wrapped in order-asserting proxies:
 
 - every ``A held while acquiring B`` acquisition records the edge
   ``A→B`` with the traceback of its first witness;
@@ -19,10 +20,23 @@ order-asserting proxies:
 - re-acquiring a held non-reentrant ``Lock`` raises instead of
   deadlocking silently.
 
+``KBT_LOCK_DEBUG=2`` keeps everything level 1 does and additionally
+arms the **write-witness**: shared-state classes register their
+lock-guarded attributes at the end of ``__init__`` via
+:func:`witness_writes` (same named-lock identities as ``wrap_lock``),
+and every subsequent ``obj.attr = ...`` of a registered attribute on a
+thread NOT holding the named lock raises
+:class:`GuardedWriteViolation` with the writing site — the runtime
+twin of kbtlint's guarded-by inference, catching the unguarded writes
+the static pass cannot resolve (dynamic dispatch, exec'd plugins).
+``KBT_LOCK_WITNESS_SAMPLE=N`` checks every Nth guarded write (default
+1 = all) when the full check is too hot for a soak.
+
 Off by default and zero-cost when off: ``wrap_lock`` returns the raw
-lock unless the env flag is set at construction time. The chaos/micro
-smoke suites run with the flag on (Makefile), so every injected fault
-storm doubles as a lock-order soak. Violations are additionally
+lock and ``witness_writes`` is a no-op unless the env flag is set at
+construction time. The chaos/micro smoke suites run with
+``KBT_LOCK_DEBUG=2`` (Makefile), so every injected fault storm doubles
+as a lock-order AND write-ownership soak. Violations are additionally
 collected in :data:`VIOLATIONS` for harness-level assertions.
 
 Condition variables: pass a wrapped lock to ``threading.Condition`` —
@@ -53,6 +67,12 @@ class LockOrderViolation(AssertionError):
     tracebacks of both acquisition sites."""
 
 
+class GuardedWriteViolation(AssertionError):
+    """A registered lock-guarded attribute was written by a thread not
+    holding its named lock (``KBT_LOCK_DEBUG=2``). Message carries the
+    writing site."""
+
+
 # (held_name, acquired_name) -> formatted traceback of first witness
 _edges: Dict[Tuple[str, str], str] = {}
 _edges_lock = threading.Lock()  # raw on purpose: the meta-lock
@@ -61,16 +81,29 @@ _tls = threading.local()
 VIOLATIONS: List[str] = []
 
 
+def level() -> int:
+    raw = os.environ.get(LOCK_DEBUG_ENV, "0")
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 0
+
+
 def enabled() -> bool:
-    return os.environ.get(LOCK_DEBUG_ENV, "0") == "1"
+    return level() >= 1
+
+
+def witness_enabled() -> bool:
+    return level() >= 2
 
 
 def reset() -> None:
-    """Clear recorded edges/violations (tests; each harness run starts
-    from an empty order history)."""
+    """Clear recorded edges/violations and the witness sample cache
+    (tests; each harness run starts from an empty order history)."""
     with _edges_lock:
         _edges.clear()
         del VIOLATIONS[:]
+    _witness_sample_cached[0] = 0
 
 
 def _held() -> List[List]:
@@ -87,10 +120,10 @@ def _site() -> str:
     return "".join(frames[-12:])
 
 
-def _violate(message: str) -> None:
+def _violate(message: str, exc_type=LockOrderViolation) -> None:
     if len(VIOLATIONS) < _MAX_VIOLATIONS:
         VIOLATIONS.append(message)
-    raise LockOrderViolation(message)
+    raise exc_type(message)
 
 
 def _check_order(name: str, reentrant: bool) -> None:
@@ -255,3 +288,75 @@ def wrap_lock(name: str, lock=None):
     if isinstance(lock, type(threading.RLock())):
         return _OrderAssertingRLock(name, lock)
     return _OrderAssertingLock(name, lock)
+
+
+# -- guarded-write witness (KBT_LOCK_DEBUG=2) --------------------------------
+
+WITNESS_SAMPLE_ENV = "KBT_LOCK_WITNESS_SAMPLE"
+
+# (class, lock_name, attrs) -> generated witness subclass, so every
+# instance of one registration shape shares one class object.
+_witness_classes: Dict[tuple, type] = {}
+_witness_counter = [0]  # guarded-write serial for sampling
+_witness_sample_cached = [0]  # 0 = unresolved
+
+
+def _witness_sample() -> int:
+    if not _witness_sample_cached[0]:
+        raw = os.environ.get(WITNESS_SAMPLE_ENV, "1")
+        try:
+            _witness_sample_cached[0] = max(1, int(raw))
+        except ValueError:
+            _witness_sample_cached[0] = 1
+    return _witness_sample_cached[0]
+
+
+def _holds(lock_name: str) -> bool:
+    return any(entry[0] == lock_name for entry in _held())
+
+
+def _witness_check(cls_name: str, lock_name: str, attr: str) -> None:
+    if not witness_enabled():
+        # A witnessed instance outlives an env change (tests lower the
+        # level on teardown; the class swap is permanent) — the check
+        # must track the LIVE level, not the level at registration.
+        return
+    _witness_counter[0] += 1
+    if _witness_counter[0] % _witness_sample():
+        return
+    if _holds(lock_name):
+        return
+    _violate(
+        f"guarded-write violation: {cls_name}.{attr} written without "
+        f"holding {lock_name!r}\nwrite site:\n{_site()}",
+        exc_type=GuardedWriteViolation,
+    )
+
+
+def witness_writes(obj, lock_name: str, attrs) -> None:
+    """Arm the write-witness on ``obj``: any later ``obj.<attr> = ...``
+    for ``attr`` in ``attrs`` on a thread not holding ``lock_name``
+    raises :class:`GuardedWriteViolation`. No-op below
+    ``KBT_LOCK_DEBUG=2``. Call at the END of ``__init__`` — writes
+    before arming are construction (happens-before publication) and
+    exempt by design."""
+    if not witness_enabled():
+        return
+    cls = type(obj)
+    key = (cls, lock_name, frozenset(attrs))
+    wcls = _witness_classes.get(key)
+    if wcls is None:
+        guarded = frozenset(attrs)
+
+        def __setattr__(self, name, value, _cls=cls, _g=guarded,
+                        _lock=lock_name):
+            if name in _g:
+                _witness_check(_cls.__name__, _lock, name)
+            _cls.__setattr__(self, name, value)
+
+        wcls = type(
+            f"{cls.__name__}(witnessed)", (cls,),
+            {"__setattr__": __setattr__, "__module__": cls.__module__},
+        )
+        _witness_classes[key] = wcls
+    obj.__class__ = wcls

@@ -11,7 +11,16 @@ import sys
 
 import pytest
 
-from tools.kbtlint import census, core, dirty_ledger, jit_hygiene, lock_order
+from tools.kbtlint import (
+    census,
+    core,
+    dirty_ledger,
+    guarded_by,
+    jit_hygiene,
+    lock_order,
+    replay_det,
+    shape_contracts,
+)
 from tools.kbtlint.selftest import run_selftest
 
 REPO = core.REPO
@@ -135,6 +144,268 @@ class TestJitHygiene:
         assert kept == [], [f.render() for f in kept]
 
 
+# -- guarded-by --------------------------------------------------------------
+
+
+class TestGuardedBy:
+    def test_unguarded_write_flagged(self):
+        findings = guarded_by.run(fixture_project("guarded_bad.py"))
+        assert any("guarded-by violation" in f.message for f in findings)
+        assert any("racy_reset" in f.message for f in findings)
+
+    def test_locked_helper_inference_accepted(self):
+        """_set() never takes the lock itself — every call site holds
+        it, and the entry-held fixed point must see that."""
+        assert guarded_by.run(fixture_project("guarded_good.py")) == []
+
+    def test_init_writes_exempt(self):
+        project = core.load_snippet(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.x = 0\n"  # pre-publication: exempt
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self.x = 1\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            self.x = 2\n"
+            "    def c(self):\n"
+            "        with self._lock:\n"
+            "            self.x = 3\n"
+            "    def d(self):\n"
+            "        with self._lock:\n"
+            "            return self.x\n"
+        )
+        assert guarded_by.run(project) == []
+
+    def test_below_evidence_threshold_quiet(self):
+        # Two guarded + one unguarded access: too thin to infer.
+        project = core.load_snippet(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self.x = 1\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            self.x = 2\n"
+            "    def c(self):\n"
+            "        self.x = 3\n"
+        )
+        assert guarded_by.run(project) == []
+
+    def test_mutating_call_counts_once(self):
+        """Regression: ``self.items.append(...)`` is ONE access (a
+        write through the attribute), not a write plus a re-walked
+        read — double-counting inflated the inference evidence and
+        duplicated findings."""
+        project = core.load_snippet(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.items = []\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            self.items.append(1)\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            self.items.append(2)\n"
+            "    def c(self):\n"
+            "        with self._lock:\n"
+            "            self.items.append(3)\n"
+            "    def d(self):\n"
+            "        with self._lock:\n"
+            "            self.items.append(4)\n"
+            "    def racy(self):\n"
+            "        self.items.append(5)\n"
+        )
+        findings = guarded_by.run(project)
+        assert len(findings) == 1, [f.render() for f in findings]
+        assert "4/5 accesses" in findings[0].message
+
+    def test_real_tree_clean(self):
+        project = core.load_project()
+        findings = guarded_by.run(project)
+        entries = core.load_allowlist()
+        kept, _, _ = core.apply_allowlist(findings, entries)
+        assert kept == [], [f.render() for f in kept]
+
+
+# -- replay-determinism ------------------------------------------------------
+
+
+class TestReplayDeterminism:
+    def test_all_taint_classes_flagged(self):
+        findings = replay_det.run(fixture_project("replay_bad.py"))
+        messages = [f.message for f in findings]
+        assert any("wall-clock read time()" in m for m in messages)
+        assert any("module-level RNG" in m for m in messages)
+        assert any("os.environ read" in m for m in messages)
+        assert any("iteration over an unordered set" in m for m in messages)
+        assert any("id()-keyed ordering" in m for m in messages)
+        assert any("set.pop()" in m for m in messages)
+
+    def test_sanctioned_forms_clean(self):
+        assert replay_det.run(fixture_project("replay_good.py")) == []
+
+    def test_duration_clocks_exempt(self):
+        project = core.load_snippet(
+            "import time\n"
+            "def f():\n"
+            "    return time.perf_counter() - time.monotonic()\n"
+        )
+        assert replay_det.run(project) == []
+
+    def test_sorted_set_iteration_clean(self):
+        project = core.load_snippet(
+            "def f(xs):\n"
+            "    s = set(xs)\n"
+            "    return [x for x in sorted(s)]\n"
+        )
+        assert replay_det.run(project) == []
+
+    def test_real_tree_clean_modulo_allowlist(self):
+        project = core.load_project()
+        findings = replay_det.run(project)
+        entries = core.load_allowlist()
+        kept, _, _ = core.apply_allowlist(findings, entries)
+        assert kept == [], [f.render() for f in kept]
+
+    def test_reachability_covers_warm_and_sim(self):
+        project = core.load_project()
+        reachable = replay_det._reachable(project)
+        assert any("solver/warm.py" in key for key in reachable)
+        assert any("sim/harness.py" in key for key in reachable)
+
+
+# -- shape-contracts ---------------------------------------------------------
+
+
+class TestShapeContracts:
+    def test_every_check_fires_on_bad_fixture(self):
+        findings = shape_contracts.run(fixture_project("contracts_bad.py"))
+        messages = [f.message for f in findings]
+        assert any("no entry in the contract table" in m for m in messages)
+        assert any("stale contract row" in m for m in messages)
+        assert any("comment declares shape" in m for m in messages)
+        assert any("_ROW_AXIS says axis" in m for m in messages)
+        assert any("producer dict never ships it" in m for m in messages)
+        assert any("out of range" in m for m in messages)
+
+    def test_good_fixture_clean(self):
+        assert shape_contracts.run(fixture_project("contracts_good.py")) == []
+
+    def test_real_tree_clean(self):
+        project = core.load_project()
+        findings = shape_contracts.run(project)
+        entries = core.load_allowlist()
+        kept, _, _ = core.apply_allowlist(findings, entries)
+        assert kept == [], [f.render() for f in kept]
+
+    def test_tables_cover_live_namedtuples(self):
+        """The declaration table and the real NamedTuples agree — the
+        runtime import view, complementing the AST view the pass uses."""
+        from kube_batch_tpu.solver import contracts
+        from kube_batch_tpu.solver.kernels import PackedInputs, SolverInputs
+
+        assert set(SolverInputs._fields) == set(
+            contracts.SOLVER_INPUT_CONTRACTS
+        )
+        assert set(PackedInputs._fields) == set(
+            contracts.PACKED_INPUT_CONTRACTS
+        )
+
+    def test_row_axis_matches_device_cache(self):
+        from kube_batch_tpu.solver import contracts, device_cache
+
+        declared = {
+            name: c["row_axis"]
+            for name, c in contracts.PACKED_INPUT_CONTRACTS.items()
+        }
+        assert declared == device_cache._ROW_AXIS
+
+    def test_runtime_validator_roundtrip(self):
+        import numpy as np
+
+        from kube_batch_tpu.solver import contracts
+
+        T, N, R, Q, G = 4, 3, 2, 1, 1
+        arrays = {
+            "task_f32": np.zeros((2, T, R), np.float32),
+            "task_i32": np.zeros((6, T), np.int32),
+            "node_f32": np.zeros((3, N, R), np.float32),
+            "node_i32": np.zeros((3, N), np.int32),
+            "group_feas": np.zeros((G, N), bool),
+            "pair_idx": np.zeros((0,), np.int32),
+            "pair_feas": np.zeros((0, N), bool),
+            "score_idx": np.zeros((0,), np.int32),
+            "score_rows": np.zeros((0, N), np.float32),
+            "queue_f32": np.zeros((2, Q, R), np.float32),
+            "misc": np.zeros((R + 2,), np.float32),
+        }
+        bound = contracts.validate_packed(arrays)
+        assert bound["T"] == T and bound["N"] == N and bound["R"] == R
+
+    def test_runtime_validator_catches_dim_disagreement(self):
+        import numpy as np
+
+        import pytest as _pytest
+
+        from kube_batch_tpu.solver import contracts
+
+        arrays = {
+            "task_f32": np.zeros((2, 4, 2), np.float32),
+            # T=5 here disagrees with T=4 above.
+            "task_i32": np.zeros((6, 5), np.int32),
+        }
+        with _pytest.raises(contracts.ContractViolation, match="bound to"):
+            contracts._validate(
+                arrays,
+                {k: contracts.PACKED_INPUT_CONTRACTS[k] for k in arrays},
+                "test",
+            )
+
+    def test_runtime_validator_catches_dtype(self):
+        import numpy as np
+
+        import pytest as _pytest
+
+        from kube_batch_tpu.solver import contracts
+
+        arrays = {"task_f32": np.zeros((2, 4, 2), np.float64)}
+        with _pytest.raises(contracts.ContractViolation, match="dtype"):
+            contracts._validate(
+                arrays,
+                {"task_f32": contracts.PACKED_INPUT_CONTRACTS["task_f32"]},
+                "test",
+            )
+
+    def test_tensorize_validates_under_env(self, monkeypatch):
+        """KBT_CHECK_CONTRACTS=1 through the REAL tensorize producer:
+        the live arrays satisfy the table."""
+        monkeypatch.setenv("KBT_CHECK_CONTRACTS", "1")
+        import kube_batch_tpu.actions  # noqa: F401 (registers actions)
+        import kube_batch_tpu.plugins  # noqa: F401 (registers plugins)
+        from kube_batch_tpu.framework import close_session, open_session
+        from kube_batch_tpu.solver.snapshot import tensorize
+
+        from tests.actions.test_actions import DEFAULT_TIERS_ARGS, make_tiers
+        from tests.unit.test_cycle_pipeline import build_cluster
+
+        cluster = build_cluster()
+        ssn = open_session(cluster, make_tiers(*DEFAULT_TIERS_ARGS))
+        try:
+            inputs, ctx = tensorize(ssn, device=False)
+            assert inputs is not None
+        finally:
+            close_session(ssn)
+
+
 # -- allowlist ---------------------------------------------------------------
 
 
@@ -230,6 +501,19 @@ class TestDriver:
             cwd=REPO, capture_output=True, text=True, env=env, timeout=120,
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_single_pass_run_ignores_other_passes_allowlist(self):
+        """Regression: `--pass lock-order` must not report the
+        replay-determinism allowlist entries as stale — only entries
+        whose pass actually ran can have legitimately matched
+        nothing."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.kbtlint", "--pass", "lock-order"],
+            cwd=REPO, capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "STALE" not in proc.stdout
 
 
 # -- typecheck ratchet -------------------------------------------------------

@@ -9,7 +9,9 @@ import pytest
 
 from kube_batch_tpu.utils import lockdebug
 from kube_batch_tpu.utils.lockdebug import (
+    GuardedWriteViolation,
     LockOrderViolation,
+    witness_writes,
     wrap_lock,
 )
 
@@ -142,6 +144,116 @@ def test_violation_list_bounded():
         except LockOrderViolation:
             pass
     assert len(lockdebug.VIOLATIONS) == 5
+
+
+class _Guarded:
+    """Minimal shared-state class in the project shape: lock first,
+    state, then witness registration as the LAST line of __init__."""
+
+    def __init__(self, lock_name="t.witness"):
+        self._lock = wrap_lock(lock_name)
+        self.state = "closed"  # pre-arming: must not trip
+        self.count = 0
+        witness_writes(self, lock_name, ("state", "count"))
+
+    def set_state(self, value):
+        with self._lock:
+            self.state = value
+
+    def racy_set(self, value):
+        self.state = value
+
+
+class TestWriteWitness:
+    def test_noop_below_level_2(self, monkeypatch):
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "1")
+        obj = _Guarded()
+        obj.racy_set("open")  # witness unarmed: plain write
+        assert obj.state == "open"
+        assert type(obj).__name__ == "_Guarded"
+
+    def test_guarded_write_passes(self, monkeypatch):
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "2")
+        obj = _Guarded("t.w2")
+        obj.set_state("open")
+        assert obj.state == "open"
+
+    def test_unguarded_write_raises_with_site(self, monkeypatch):
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "2")
+        obj = _Guarded("t.w3")
+        with pytest.raises(GuardedWriteViolation) as exc:
+            obj.racy_set("open")
+        message = str(exc.value)
+        assert "t.w3" in message
+        assert "write site" in message
+        assert "racy_set" in message  # the writing frame is named
+        assert any("guarded-write" in v for v in lockdebug.VIOLATIONS)
+
+    def test_init_writes_exempt(self, monkeypatch):
+        # Construction writes precede witness_writes at the end of
+        # __init__ — building the object must not trip.
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "2")
+        obj = _Guarded("t.w4")
+        assert obj.state == "closed"
+
+    def test_unregistered_attr_unchecked(self, monkeypatch):
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "2")
+        obj = _Guarded("t.w5")
+        obj.note = "free"  # not in the registered set
+
+    def test_holding_wrong_lock_still_raises(self, monkeypatch):
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "2")
+        obj = _Guarded("t.w6")
+        other = wrap_lock("t.other6")
+        with other:
+            with pytest.raises(GuardedWriteViolation):
+                obj.state = "open"
+
+    def test_sampling_skips_unsampled_writes(self, monkeypatch):
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "2")
+        monkeypatch.setenv(lockdebug.WITNESS_SAMPLE_ENV, "1000000")
+        lockdebug.reset()  # re-resolve the sample cache
+        obj = _Guarded("t.w7")
+        # With a huge sample stride, unguarded writes slip through —
+        # sampling trades coverage for cost, deliberately.
+        for _ in range(5):
+            obj.racy_set("open")
+        assert obj.state == "open"
+
+    def test_breaker_registered_and_clean(self, monkeypatch):
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "2")
+        from kube_batch_tpu.solver.containment import reset_breaker
+
+        breaker = reset_breaker()
+        assert "witnessed" in type(breaker).__name__
+        breaker.record_device_failure("t")
+        breaker.record_device_success()
+        assert breaker.state_dict()["failure_streak"] == 0
+        with pytest.raises(GuardedWriteViolation):
+            breaker.failure_streak = 99
+        reset_breaker()
+
+    def test_witness_disarms_when_level_drops(self, monkeypatch):
+        """Regression: a witnessed instance outlives the env flag (the
+        class swap is permanent) — a global like containment.BREAKER
+        registered under level 2 must stop raising once the level
+        drops, or every later same-process test that stages state by
+        direct write fails on test order."""
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "2")
+        obj = _Guarded("t.w8")
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "0")
+        obj.racy_set("open")  # witnessed class, level 0: plain write
+        assert obj.state == "open"
+
+    def test_flightrecorder_registered_and_clean(self, monkeypatch):
+        monkeypatch.setenv(lockdebug.LOCK_DEBUG_ENV, "2")
+        from kube_batch_tpu.obs.flightrecorder import FlightRecorder
+
+        rec = FlightRecorder(capacity=4)
+        rec.begin_cycle(0)
+        rec.phase("solve")
+        rec.end_cycle(ok=True)
+        assert len(rec.snapshot()) == 1
 
 
 def test_wrapped_cache_snapshot_roundtrip():

@@ -125,3 +125,35 @@ def test_fairness_gauge_prunes_deleted_queues():
     # hide behind an empty result.
     metrics.update_telemetry_watermarks({}, fairness_ran=True)
     assert g.label_sets() == []
+
+
+def test_histogram_expose_locks_against_concurrent_mutation():
+    """Regression (kbtlint guarded-by bring-up): ``Histogram.expose``
+    iterated the label maps lock-free, so a scrape racing the scheduler
+    thread's ``observe``/series-GC could crash with "dictionary changed
+    size during iteration". It now snapshots under the lock — assert
+    mechanically that expose waits for the mutator's lock."""
+    import threading
+
+    from kube_batch_tpu.metrics.metrics import Histogram
+
+    hist = Histogram("t_h", "help", buckets=[1.0, 2.0])
+    for v in (0.5, 1.5, 3.0):
+        hist.observe(v, labels=("q",))
+
+    entered = threading.Event()
+    done = []
+
+    def scrape():
+        entered.set()
+        lines = hist.expose(("queue",))
+        done.append(lines)
+
+    with hist._lock:  # the mutator's critical section
+        worker = threading.Thread(target=scrape, daemon=True)
+        worker.start()
+        assert entered.wait(5)
+        worker.join(timeout=0.1)
+        assert not done, "expose read the maps without the lock"
+    worker.join(5)
+    assert done and any("t_h_count" in line for line in done[0])

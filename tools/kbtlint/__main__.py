@@ -39,6 +39,11 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-passes", action="store_true",
     )
+    parser.add_argument(
+        "--budget-seconds", type=float, default=None,
+        help="fail (exit 1) when the full run exceeds this wall-clock "
+             "budget — new passes must not silently make CI crawl",
+    )
     ns = parser.parse_args(argv)
 
     if ns.self_test:
@@ -76,6 +81,10 @@ def main(argv=None) -> int:
         except (core.AllowlistError, ValueError) as exc:
             print(f"allowlist error: {exc}", file=sys.stderr)
             return 2
+        # A --pass subset run must not report the other passes'
+        # entries as stale: only entries whose pass actually ran can
+        # legitimately have matched nothing.
+        entries = [e for e in entries if e.pass_id in passes]
         kept, suppressed, stale = core.apply_allowlist(findings, entries)
 
     for finding in kept:
@@ -94,7 +103,18 @@ def main(argv=None) -> int:
         f"allowlist entr(y/ies)",
         file=sys.stderr,
     )
-    return 1 if (kept or stale) else 0
+    over_budget = (
+        ns.budget_seconds is not None and elapsed > ns.budget_seconds
+    )
+    if over_budget:
+        print(
+            f"kbtlint: BUDGET EXCEEDED — {elapsed:.1f}s > "
+            f"{ns.budget_seconds:.1f}s wall-clock budget; a pass "
+            f"regressed (profile with --pass, or raise the Makefile "
+            f"budget deliberately)",
+            file=sys.stderr,
+        )
+    return 1 if (kept or stale or over_budget) else 0
 
 
 if __name__ == "__main__":

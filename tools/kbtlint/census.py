@@ -153,9 +153,19 @@ def metrics_census() -> List[Finding]:
 # -- env vars ----------------------------------------------------------------
 
 
+def _package_files(project: Project):
+    """The scheduler package only: tools/ and bench.py carry KBT_*
+    literals ABOUT the package (seeded self-test names, fixture
+    snippets, env plumbing in drivers) that are not operator knobs."""
+    for pf in project.files:
+        rel = pf.rel.replace("\\", "/")
+        if rel.startswith("kube_batch_tpu/"):
+            yield pf
+
+
 def collect_env_names(project: Project) -> Set[str]:
     names: Set[str] = set()
-    for pf in project.files:
+    for pf in _package_files(project):
         for node in ast.walk(pf.tree):
             if (
                 isinstance(node, ast.Constant)
@@ -174,7 +184,7 @@ _REC_NAMES = frozenset({"rec", "prev", "open_rec"})
 def collect_flight_keys(project: Project) -> Set[str]:
     keys: Set[str] = set()
     recorder = None
-    for pf in project.files:
+    for pf in _package_files(project):
         if pf.rel.replace("\\", "/").endswith("obs/flightrecorder.py"):
             recorder = pf
         for node in ast.walk(pf.tree):

@@ -15,10 +15,13 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Default analysis scope: the scheduler package. Tests/tools are
-# deliberately out of scope — they exercise invariants, they don't
-# carry them.
-DEFAULT_TARGETS = ("kube_batch_tpu",)
+# Default analysis scope: the scheduler package PLUS the tools/ drivers
+# and bench.py (the linter lints itself — a sim/bench driver bug skews
+# every number downstream). Tests stay out of scope: they exercise
+# invariants, they don't carry them. Pass modules narrow their own
+# scope where a rule only applies to the package (census, dirty-ledger,
+# guarded-by, replay-determinism).
+DEFAULT_TARGETS = ("kube_batch_tpu", "tools", "bench.py")
 
 
 @dataclass(frozen=True)
@@ -69,7 +72,10 @@ def _iter_py_files(root: str, targets: Sequence[str]):
             continue
         for dirpath, dirnames, filenames in os.walk(path):
             dirnames[:] = [
-                d for d in dirnames if d not in ("__pycache__", "csrc")
+                d for d in dirnames
+                # fixtures/ holds deliberately-bad snippets: the
+                # self-test's seed corpus, not project code.
+                if d not in ("__pycache__", "csrc", "fixtures")
             ]
             for fn in sorted(filenames):
                 if fn.endswith(".py"):
@@ -203,7 +209,15 @@ def register_pass(pass_id: str):
 def all_passes() -> Dict[str, PassFn]:
     # Import side effect: pass modules self-register. Kept lazy so
     # `from tools.kbtlint import core` stays cheap for tests.
-    from . import census, dirty_ledger, jit_hygiene, lock_order  # noqa: F401
+    from . import (  # noqa: F401
+        census,
+        dirty_ledger,
+        guarded_by,
+        jit_hygiene,
+        lock_order,
+        replay_det,
+        shape_contracts,
+    )
 
     return dict(_PASSES)
 

@@ -136,6 +136,8 @@ def run(project: Project) -> List[Finding]:
         if rel.startswith("kube_batch_tpu/"):
             # Only the mirror layer; sessions/actions mutate clones.
             return rel.startswith("kube_batch_tpu/cache/")
+        if rel.startswith("tools/") or rel == "bench.py":
+            return False  # drivers own no mirror or ledger
         return True  # fixtures / snippets analyze as-is
 
     cache_files = [pf for pf in project.files if in_scope(pf.rel)]

@@ -1,0 +1,62 @@
+"""Known-bad shape-contracts fixture: one violation per check —
+undeclared field, stale table row, comment/table shape drift, row-axis
+disagreement, producer dropping a field, out-of-range stack index."""
+
+from typing import NamedTuple
+
+SOLVER_INPUT_CONTRACTS = {
+    "task_req": {"shape": ["T", "R"], "dtype": "f32"},
+    "ghost_field": {"shape": ["N"], "dtype": "i32"},
+}
+
+PACKED_INPUT_CONTRACTS = {
+    "task_f32": {"shape": [2, "T", "R"], "dtype": "f32",
+                 "row_axis": 1, "donated": True},
+    "task_i32": {"shape": [6, "T"], "dtype": "i32",
+                 "row_axis": 1, "donated": True},
+    "node_f32": {"shape": [3, "N", "R"], "dtype": "f32",
+                 "row_axis": 1, "donated": True},
+    "node_i32": {"shape": [3, "N"], "dtype": "i32",
+                 "row_axis": 1, "donated": True},
+    "queue_f32": {"shape": [2, "Q", "R"], "dtype": "f32",
+                  "row_axis": 1, "donated": True},
+    "misc": {"shape": ["R+2"], "dtype": "f32",
+             "row_axis": 0, "donated": True},
+}
+
+_ROW_AXIS = {
+    "task_f32": 1,
+    "task_i32": 0,  # disagrees with the declared row_axis 1
+    "node_f32": 1,
+    "node_i32": 1,
+    "queue_f32": 1,
+    "misc": 0,
+}
+
+
+class SolverInputs(NamedTuple):
+    task_req: object    # f32[T, R] request rows
+    task_extra: object  # i32[T] undeclared: no contract table entry
+
+
+class PackedInputs(NamedTuple):
+    task_f32: object  # [3, T, R] drifted comment (table says [2, T, R])
+    task_i32: object  # i32[6, T] rank, queue, job, group, valid, cand
+    node_f32: object  # [3, N, R] idle, releasing, cap
+    node_i32: object  # [3, N] task_count, max_tasks, feas
+    queue_f32: object  # [2, Q, R] deserved, allocated
+    misc: object      # f32[R+2] eps, weights
+
+
+def pack(stack, task_req, task_fit, task_rows, nodes, node_rows, queues):
+    return {  # ships no "misc": producer census must flag it
+        "task_f32": stack([task_req, task_fit]),
+        "task_i32": stack(task_rows),
+        "node_f32": stack(nodes),
+        "node_i32": stack(node_rows),
+        "queue_f32": stack(queues),
+    }
+
+
+def unpack(p):
+    return p.node_i32[3]  # stack height is 3: one past the end

@@ -1,0 +1,56 @@
+"""Known-good shape-contracts fixture: a self-contained miniature of
+the contract surface — tables, NamedTuple comment contracts, row-axis
+map, producer dict, in-range stack indexing."""
+
+from typing import NamedTuple
+
+SOLVER_INPUT_CONTRACTS = {
+    "task_req": {"shape": ["T", "R"], "dtype": "f32"},
+}
+
+PACKED_INPUT_CONTRACTS = {
+    "task_f32": {"shape": [2, "T", "R"], "dtype": "f32",
+                 "row_axis": 1, "donated": True},
+    "task_i32": {"shape": [6, "T"], "dtype": "i32",
+                 "row_axis": 1, "donated": True},
+    "node_f32": {"shape": [3, "N", "R"], "dtype": "f32",
+                 "row_axis": 1, "donated": True},
+    "node_i32": {"shape": [3, "N"], "dtype": "i32",
+                 "row_axis": 1, "donated": True},
+    "misc": {"shape": ["R+2"], "dtype": "f32",
+             "row_axis": 0, "donated": True},
+}
+
+_ROW_AXIS = {
+    "task_f32": 1,
+    "task_i32": 1,
+    "node_f32": 1,
+    "node_i32": 1,
+    "misc": 0,
+}
+
+
+class SolverInputs(NamedTuple):
+    task_req: object  # f32[T, R] request rows
+
+
+class PackedInputs(NamedTuple):
+    task_f32: object  # [2, T, R] req, fit
+    task_i32: object  # i32[6, T] rank, queue, job, group, valid, cand
+    node_f32: object  # [3, N, R] idle, releasing, cap
+    node_i32: object  # [3, N] task_count, max_tasks, feas
+    misc: object      # f32[R+2] eps, weights
+
+
+def pack(stack, task_req, task_fit, task_rows, nodes, node_rows, misc):
+    return {
+        "task_f32": stack([task_req, task_fit]),
+        "task_i32": stack(task_rows),
+        "node_f32": stack(nodes),
+        "node_i32": stack(node_rows),
+        "misc": stack(misc),
+    }
+
+
+def unpack(p):
+    return p.task_i32[5], p.node_f32[2], p.task_f32[0]

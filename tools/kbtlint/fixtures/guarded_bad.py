@@ -1,0 +1,30 @@
+"""Known-bad guarded-by fixture: four of five accesses of ``state``
+hold the lock — the inference calls it guarded — and the fifth write
+races them."""
+
+import threading
+
+
+class Breaker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "closed"
+
+    def open(self):
+        with self._lock:
+            self.state = "open"
+
+    def close(self):
+        with self._lock:
+            self.state = "closed"
+
+    def half_open(self):
+        with self._lock:
+            self.state = "half-open"
+
+    def read(self):
+        with self._lock:
+            return self.state
+
+    def racy_reset(self):
+        self.state = "closed"  # no lock: the seeded violation

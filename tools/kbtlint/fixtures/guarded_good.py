@@ -1,0 +1,36 @@
+"""Known-good guarded-by fixture: every access of ``state`` holds the
+lock — including through a private ``_locked`` helper whose call sites
+all hold it (the entry-held fixed point), and construction writes in
+``__init__`` (exempt)."""
+
+import threading
+
+
+class Breaker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.config = "static"  # never written post-init: no guard
+
+    def open(self):
+        with self._lock:
+            self._set("open")
+
+    def close(self):
+        with self._lock:
+            self._set("closed")
+
+    def half_open(self):
+        with self._lock:
+            self._set("half-open")
+
+    def read(self):
+        with self._lock:
+            return self.state
+
+    def describe(self):
+        return self.config  # unguarded read of an immutable attr: fine
+
+    def _set(self, state):
+        # Lock held by every caller (inferred, not declared).
+        self.state = state

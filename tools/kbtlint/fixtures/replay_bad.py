@@ -1,0 +1,19 @@
+"""Known-bad replay-determinism fixture: every taint class on one
+recordable path."""
+
+import os
+import random
+import time
+
+
+def record_cycle(events):
+    stamp = time.time()                 # wall-clock read
+    jitter = random.random()            # module-level RNG
+    mode = os.environ.get("SIM_MODE")   # environment read
+    pending = set(events)
+    ordered = []
+    for event in pending:               # unordered set iteration
+        ordered.append(event)
+    ordered.sort(key=id)                # id()-keyed ordering
+    first = pending.pop()               # set.pop(): hash order
+    return stamp, jitter, mode, ordered, first

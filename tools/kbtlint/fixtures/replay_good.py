@@ -1,0 +1,16 @@
+"""Known-good replay-determinism fixture: the sanctioned forms of
+every taint class — seeded generator, sorted set walks, duration
+clocks."""
+
+import random
+import time
+
+
+def record_cycle(events, seed):
+    rng = random.Random(seed)           # seeded generator: fine
+    t0 = time.monotonic()               # duration clock: fine
+    pending = set(events)
+    ordered = [event for event in sorted(pending)]
+    by_name = sorted((e for e in pending), key=str)
+    elapsed = time.monotonic() - t0
+    return rng.random(), ordered, by_name, elapsed

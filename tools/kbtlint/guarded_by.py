@@ -1,0 +1,452 @@
+"""Pass 5: guarded-by inference (the concurrent-mutator race class,
+mechanical).
+
+The shared mutable classes (SchedulerCache, the solver circuit
+breaker, the telemetry/flight-recorder rings, ...) each own a named
+lock, but which *attributes* that lock guards is convention — and the
+next wave of concurrent mutators (sharded solves, primary micro-cycles)
+will be written against that convention, not against a check. This
+pass makes the convention mechanical by INFERENCE rather than
+declaration:
+
+1. every class that constructs an instance lock (``self.X =
+   threading.Lock()/RLock()`` / ``wrap_lock(...)``) is a *guarded
+   class*; its methods — including methods contributed by in-project
+   base classes/mixins (``SchedulerCache`` + ``EventHandlersMixin``) —
+   are walked with the held-lock stack tracked lexically;
+2. a private helper (``_``-prefixed) whose in-group ``self.`` call
+   sites ALL hold a lock is treated as entered with that lock held
+   (fixed point over the self-call graph — ``_set_state`` is "lock
+   held by caller" without a declaration);
+3. per attribute, accesses are counted guarded/unguarded; an attribute
+   with at least :data:`INFER_MIN_GUARDED` guarded accesses where at
+   least :data:`INFER_RATIO` of all accesses hold the same lock is
+   *inferred guarded by that lock* — and every remaining unguarded
+   read/write is a finding.
+
+Only attributes that are WRITTEN outside ``__init__`` somewhere
+participate: construct-then-publish config attributes need no guard,
+and counting their reads would drown the signal. ``__init__`` /
+``__new__`` / ``__del__`` accesses are exempt on the standard
+happens-before-publication argument. Attributes that are themselves
+locks are skipped.
+
+The runtime twin is ``KBT_LOCK_DEBUG=2`` (utils/lockdebug.py): a
+write-witness on the same named-lock set that raises on any observed
+unguarded write of a registered attribute, armed in the chaos/micro
+smokes.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    Finding,
+    Project,
+    attr_chain,
+    call_name,
+    register_pass,
+)
+from .lock_order import LockIndex
+
+PASS_ID = "guarded-by"
+
+# Inference thresholds: an attribute is inferred lock-guarded when at
+# least INFER_MIN_GUARDED of its accesses hold one lock and those are
+# at least INFER_RATIO of all its accesses. Below either bound the
+# evidence is too thin to call the convention (and the finding would be
+# a guess, not an inference).
+INFER_MIN_GUARDED = 4
+INFER_RATIO = 0.75
+
+# Methods exempt from both counting and flagging: accesses before the
+# object is published (or while it is being torn down) race with
+# nothing.
+EXEMPT_METHODS = frozenset({"__init__", "__new__", "__del__", "__post_init__"})
+
+# Receiver-method names that mutate the receiver in place — an access
+# through one of these is a WRITE for classification purposes.
+MUTATING_CALLS = frozenset({
+    "append", "appendleft", "add", "remove", "discard", "pop", "popleft",
+    "clear", "update", "setdefault", "extend", "insert", "sort",
+    "difference_update", "intersection_update", "put", "put_nowait",
+})
+
+
+@dataclass
+class Access:
+    attr: str
+    kind: str  # "read" | "write"
+    method: str  # qualname of the accessing method
+    rel: str
+    line: int
+    held: frozenset  # lock attr names held at the site
+
+
+@dataclass
+class GuardedClass:
+    """One guarded class: the union of its own methods and those of its
+    in-project bases (mixins are halves of one runtime object)."""
+
+    name: str
+    rel: str
+    lock_attrs: Set[str]
+    methods: Dict[str, List[ast.AST]]  # method name -> def nodes
+    method_rel: Dict[str, str]  # method name -> defining file
+
+
+def _class_defs(project: Project):
+    """Yield (rel, ClassDef) for every top-level class (including ones
+    nested in If/Try at module level)."""
+    for pf in project.files:
+        def walk(nodes):
+            for node in nodes:
+                if isinstance(node, ast.ClassDef):
+                    yield node
+                elif isinstance(node, (ast.If, ast.Try)):
+                    yield from walk(ast.iter_child_nodes(node))
+
+        for cls in walk(pf.tree.body):
+            yield pf.rel, cls
+
+
+def _collect_classes(project: Project, locks: LockIndex) -> List[GuardedClass]:
+    by_name: Dict[str, Tuple[str, ast.ClassDef]] = {}
+    for rel, cls in _class_defs(project):
+        by_name.setdefault(cls.name, (rel, cls))
+
+    # Instance lock attrs per defining class name.
+    lock_attrs: Dict[str, Set[str]] = {}
+    for d in locks.defs:
+        if d.cls is not None:
+            lock_attrs.setdefault(d.cls, set()).add(d.attr)
+
+    out: List[GuardedClass] = []
+    for name, (rel, cls) in by_name.items():
+        # Merge the class with its in-project bases: a mixin's methods
+        # run on the derived object and see its locks.
+        group_names = [name]
+        seen = {name}
+        i = 0
+        while i < len(group_names):
+            _, node = by_name.get(group_names[i], (None, None))
+            i += 1
+            if node is None:
+                continue
+            for base in node.bases:
+                base_name = base.id if isinstance(base, ast.Name) else (
+                    base.attr if isinstance(base, ast.Attribute) else None
+                )
+                if base_name and base_name in by_name and base_name not in seen:
+                    seen.add(base_name)
+                    group_names.append(base_name)
+        attrs: Set[str] = set()
+        for member in group_names:
+            attrs |= lock_attrs.get(member, set())
+        if not attrs:
+            continue
+        methods: Dict[str, List[ast.AST]] = {}
+        method_rel: Dict[str, str] = {}
+        for member in group_names:
+            member_rel, node = by_name[member]
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.setdefault(stmt.name, []).append(stmt)
+                    method_rel.setdefault(stmt.name, member_rel)
+        out.append(GuardedClass(
+            name=name, rel=rel, lock_attrs=attrs, methods=methods,
+            method_rel=method_rel,
+        ))
+    # A mixin that is also listed standalone would double-count its
+    # accesses: drop groups whose every method already belongs to a
+    # larger group (the derived class).
+    covered: Set[int] = set()
+    for i, gc in enumerate(out):
+        for j, other in enumerate(out):
+            if i == j or len(other.methods) <= len(gc.methods):
+                continue
+            if (
+                gc.lock_attrs <= other.lock_attrs
+                and set(gc.methods) <= set(other.methods)
+            ):
+                covered.add(i)
+                break
+    return [gc for i, gc in enumerate(out) if i not in covered]
+
+
+def _lock_expr_attr(expr: ast.AST, lock_attrs: Set[str]) -> Optional[str]:
+    chain = attr_chain(expr)
+    if (
+        chain is not None
+        and len(chain) == 2
+        and chain[0] in ("self", "cls")
+        and chain[1] in lock_attrs
+    ):
+        return chain[1]
+    return None
+
+
+def _walk_method(
+    gc: GuardedClass, method_name: str, node: ast.AST, entry_held: frozenset
+) -> Tuple[List[Access], List[Tuple[str, frozenset]]]:
+    """Accesses and in-group self-call sites (name, held) of one method,
+    with the lexically-held lock set tracked through ``with`` blocks."""
+    accesses: List[Access] = []
+    self_calls: List[Tuple[str, frozenset]] = []
+    rel = gc.method_rel.get(method_name, gc.rel)
+    qual = f"{gc.name}.{method_name}"
+
+    def record(attr: str, kind: str, line: int, held: frozenset) -> None:
+        if attr in gc.lock_attrs or attr.startswith("__"):
+            return
+        accesses.append(Access(
+            attr=attr, kind=kind, method=qual, rel=rel, line=line,
+            held=held,
+        ))
+
+    def scan_expr(expr: ast.AST, held: frozenset,
+                  skip: Optional[Set[int]] = None) -> None:
+        skip = skip or set()
+        for sub in ast.walk(expr):
+            if id(sub) in skip:
+                continue
+            if isinstance(sub, ast.Call):
+                name = call_name(sub)
+                fn = sub.func
+                if isinstance(fn, ast.Attribute):
+                    recv = fn.value
+                    recv_chain = attr_chain(recv)
+                    if (
+                        recv_chain is not None
+                        and len(recv_chain) == 2
+                        and recv_chain[0] in ("self", "cls")
+                    ):
+                        # self.attr.method(...): data access through attr.
+                        kind = (
+                            "write" if name in MUTATING_CALLS else "read"
+                        )
+                        record(recv_chain[1], kind, sub.lineno, held)
+                        # Skip BOTH the method Attribute and its
+                        # receiver chain — the walk would otherwise
+                        # re-record this same access as a read.
+                        skip.add(id(fn))
+                        skip.add(id(recv))
+                    elif (
+                        isinstance(fn.value, ast.Name)
+                        and fn.value.id in ("self", "cls")
+                        and name in gc.methods
+                    ):
+                        self_calls.append((name, held))
+            elif isinstance(sub, ast.Attribute):
+                chain = attr_chain(sub)
+                if (
+                    chain is not None
+                    and len(chain) >= 2
+                    and chain[0] in ("self", "cls")
+                ):
+                    if len(chain) == 2 and chain[1] in gc.methods:
+                        continue  # bound-method reference, not data
+                    kind = (
+                        "write"
+                        if isinstance(sub.ctx, (ast.Store, ast.Del))
+                        else "read"
+                    )
+                    record(chain[1], kind, sub.lineno, held)
+                    # Do not re-record the inner Attribute nodes of the
+                    # same chain.
+                    inner = sub.value
+                    while isinstance(inner, ast.Attribute):
+                        skip.add(id(inner))
+                        inner = inner.value
+
+    def scan_target(target: ast.AST, held: frozenset) -> None:
+        # Assignment targets: self.attr = ... is a write of attr;
+        # self.attr[k] = ... is a write THROUGH attr (read of the
+        # binding, mutation of the object) — count as write.
+        if isinstance(target, ast.Attribute):
+            chain = attr_chain(target)
+            if (
+                chain is not None
+                and len(chain) >= 2
+                and chain[0] in ("self", "cls")
+            ):
+                record(chain[1], "write", target.lineno, held)
+                return
+        if isinstance(target, ast.Subscript):
+            chain = attr_chain(target.value)
+            if (
+                chain is not None
+                and len(chain) >= 2
+                and chain[0] in ("self", "cls")
+            ):
+                record(chain[1], "write", target.lineno, held)
+                scan_expr(target.slice, held)
+                return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                scan_target(elt, held)
+            return
+        scan_expr(target, held)
+
+    def scan_stmts(stmts, held: frozenset) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                inner = held
+                for item in stmt.items:
+                    acquired = _lock_expr_attr(
+                        item.context_expr, gc.lock_attrs
+                    )
+                    if acquired is not None:
+                        inner = inner | {acquired}
+                    else:
+                        scan_expr(item.context_expr, inner)
+                scan_stmts(stmt.body, inner)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Closures defined under a lock are assumed to run
+                # under it (conservative in the quiet direction).
+                scan_stmts(stmt.body, held)
+            elif isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign) else [stmt.target]
+                )
+                for target in targets:
+                    scan_target(target, held)
+                scan_expr(stmt.value, held)
+            elif isinstance(stmt, ast.AnnAssign):
+                scan_target(stmt.target, held)
+                if stmt.value is not None:
+                    scan_expr(stmt.value, held)
+            elif isinstance(stmt, ast.Delete):
+                for target in stmt.targets:
+                    scan_target(target, held)
+            elif isinstance(stmt, ast.Try):
+                scan_stmts(stmt.body, held)
+                for handler in stmt.handlers:
+                    scan_stmts(handler.body, held)
+                scan_stmts(stmt.orelse, held)
+                scan_stmts(stmt.finalbody, held)
+            elif isinstance(
+                stmt, (ast.If, ast.While, ast.For, ast.AsyncFor)
+            ):
+                for child in ast.iter_child_nodes(stmt):
+                    if not isinstance(child, ast.stmt):
+                        scan_expr(child, held)
+                scan_stmts(getattr(stmt, "body", []), held)
+                scan_stmts(getattr(stmt, "orelse", []), held)
+            else:
+                scan_expr(stmt, held)
+
+    scan_stmts(node.body, entry_held)
+    return accesses, self_calls
+
+
+def _entry_held_fixed_point(
+    gc: GuardedClass,
+) -> Dict[str, frozenset]:
+    """Locks a method is entered with: intersection over all in-group
+    self-call sites of (lexically held there ∪ caller's entry set),
+    private methods only — a public method can always be called bare
+    from outside the class."""
+    all_locks = frozenset(gc.lock_attrs)
+    entry: Dict[str, frozenset] = {
+        name: (
+            all_locks
+            if name.startswith("_") and name not in EXEMPT_METHODS
+            else frozenset()
+        )
+        for name in gc.methods
+    }
+    for _ in range(len(gc.methods) + 2):
+        changed = False
+        incoming: Dict[str, List[frozenset]] = {}
+        for name, nodes in gc.methods.items():
+            if name in EXEMPT_METHODS:
+                continue
+            for node in nodes:
+                _, self_calls = _walk_method(gc, name, node, entry[name])
+                for callee, held in self_calls:
+                    incoming.setdefault(callee, []).append(held)
+        for name in gc.methods:
+            if not name.startswith("_") or name in EXEMPT_METHODS:
+                continue
+            sites = incoming.get(name)
+            new = (
+                frozenset.intersection(*sites) if sites else frozenset()
+            )
+            if new != entry[name]:
+                entry[name] = new
+                changed = True
+        if not changed:
+            break
+    return entry
+
+
+def analyze_class(gc: GuardedClass) -> List[Finding]:
+    entry = _entry_held_fixed_point(gc)
+    accesses: List[Access] = []
+    for name, nodes in gc.methods.items():
+        if name in EXEMPT_METHODS:
+            continue
+        for node in nodes:
+            acc, _ = _walk_method(gc, name, node, entry.get(name, frozenset()))
+            accesses.extend(acc)
+
+    by_attr: Dict[str, List[Access]] = {}
+    for access in accesses:
+        by_attr.setdefault(access.attr, []).append(access)
+
+    findings: List[Finding] = []
+    for attr, acc in sorted(by_attr.items()):
+        if not any(a.kind == "write" for a in acc):
+            continue  # never mutated post-init: no guard to infer
+        total = len(acc)
+        best_lock, best_count = None, 0
+        for lock in gc.lock_attrs:
+            count = sum(1 for a in acc if lock in a.held)
+            if count > best_count:
+                best_lock, best_count = lock, count
+        if best_lock is None or best_count < INFER_MIN_GUARDED:
+            continue
+        if best_count / total < INFER_RATIO:
+            continue
+        for a in acc:
+            if best_lock in a.held:
+                continue
+            findings.append(Finding(
+                PASS_ID, a.rel, a.line,
+                f"guarded-by violation: {gc.name}.{attr} {a.kind} "
+                f"without holding self.{best_lock} in {a.method} "
+                f"(inferred guard: {best_count}/{total} accesses hold "
+                f"it) — an unguarded {a.kind} races every guarded "
+                f"mutator of this attribute",
+            ))
+    return findings
+
+
+@register_pass(PASS_ID)
+def run(project: Project) -> List[Finding]:
+    def in_scope(rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        if rel.startswith("tools/") or rel == "bench.py":
+            # Driver scripts are single-threaded by construction; their
+            # ad-hoc classes carry no cross-thread guarantees to infer.
+            return False
+        return True
+
+    locks = LockIndex(project)
+    findings: List[Finding] = []
+    scoped = Project(root=project.root)
+    scoped.files = [pf for pf in project.files if in_scope(pf.rel)]
+    for gc in _collect_classes(scoped, locks):
+        findings.extend(analyze_class(gc))
+    # A base class shared by several guarded groups contributes its
+    # methods to each: dedupe identical findings.
+    unique = {(f.file, f.line, f.message): f for f in findings}
+    findings = sorted(
+        unique.values(), key=lambda f: (f.file, f.line, f.message)
+    )
+    return findings

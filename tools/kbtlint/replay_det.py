@@ -1,0 +1,354 @@
+"""Pass 6: replay-determinism lint (the bit-equal-replay class,
+mechanical).
+
+The simulator's record/replay guarantee — replaying a trace reproduces
+every placement byte-for-byte — plus the warm-start state machine's
+"bit-parity with a cold scheduler" invariant were each re-proved by
+hand in PRs 4-8. This pass states the mechanical core: on any code
+path reachable from the sim record/replay stack, the warm-start state
+machine, or solver verdict production, nothing may consult a source
+that differs between a recording run and its replay:
+
+- **absolute wall-clock reads** — ``time.time()``/``time_ns()``/
+  ``datetime.now()`` and friends. Duration clocks (``perf_counter``/
+  ``monotonic``/``process_time``) are exempt by rule: they measure
+  elapsed time for stats and deadlines, both outside the bit-equal
+  contract (placements are the verified quantity; a deadline trip is
+  a fault the trace records as an event);
+- **module-level RNG** — ``random.x(...)`` / ``np.random.x(...)``
+  (seeded ``random.Random(seed)`` / ``np.random.default_rng(seed)``
+  instances resolve through a variable receiver and are fine);
+- **environment reads** — ``os.environ[...]`` / ``.get`` /
+  ``os.getenv``: an env difference between record and replay silently
+  changes behavior with no trace-header witness;
+- **unordered iteration** — ``for x in <set>`` (set literals,
+  ``set()``/``frozenset()`` constructions, locals assigned from one,
+  set-algebra binops) and ``<set>.pop()``: string-hash randomization
+  makes the order differ across PROCESSES, which is exactly the
+  record-vs-replay boundary. ``sorted(<set>)`` is the fix and is not
+  flagged;
+- **id()-keyed ordering** — ``sorted(key=id)`` / ``.sort(key=id)`` /
+  ``min/max(key=id)`` (including through a lambda): id order is
+  allocation order, different every run. id()-keyed *lookup* is fine
+  (deterministic within a process) and not flagged.
+
+Reachability: forward closure over the project call graph from every
+function in ``ROOT_PREFIXES`` (sim/, solver/warm.py, the allocate
+action's verdict production). Observability sinks (obs/, metrics/) and
+the CLI are exempt: their OUTPUT is explicitly outside the bit-equal
+contract — placements are the replay-verified quantity — and wall
+clocks are their job.
+
+The runtime twin is the replay harness itself (``sim --replay`` diffs
+placements byte-for-byte; the soak detectors replay-bisect any drift);
+this pass is the static front door that catches the class before a
+soak has to.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .callgraph import get_callgraph
+from .core import (
+    Finding,
+    Project,
+    attr_chain,
+    call_name,
+    iter_functions,
+    register_pass,
+)
+
+PASS_ID = "replay-determinism"
+
+# Forward-closure roots: record/replay, warm-start, verdict production.
+ROOT_PREFIXES = (
+    "kube_batch_tpu/sim/",
+    "kube_batch_tpu/solver/warm.py",
+    "kube_batch_tpu/actions/allocate_tpu.py",
+)
+
+# Reachable-but-exempt: observability output is outside the bit-equal
+# replay contract (placements are the verified quantity), and the CLI /
+# lockdebug layers are process plumbing.
+EXEMPT_PREFIXES = (
+    "kube_batch_tpu/obs/",
+    "kube_batch_tpu/metrics/",
+    "kube_batch_tpu/cli/",
+    "kube_batch_tpu/utils/lockdebug.py",
+    "kube_batch_tpu/utils/gc_guard.py",
+)
+
+# ABSOLUTE clocks only. Duration clocks (perf_counter/monotonic/
+# process_time) measure elapsed time for stats and deadlines — both
+# outside the bit-equal contract (placements are the verified
+# quantity; a deadline trip is a fault the trace records as an event).
+# Absolute time is what leaks into records, filenames, and carried
+# state.
+WALLCLOCK_NAMES = frozenset({
+    "time", "time_ns", "now", "utcnow", "today",
+})
+WALLCLOCK_RECEIVERS = frozenset({"time", "datetime", "date"})
+
+SET_CTORS = frozenset({"set", "frozenset"})
+SET_BINOPS = (ast.BitOr, ast.BitAnd, ast.BitXor, ast.Sub)
+ORDERING_CALLS = frozenset({"sorted", "min", "max", "sort"})
+
+
+def _receiver_chain(node: ast.Call) -> Optional[List[str]]:
+    if isinstance(node.func, ast.Attribute):
+        return attr_chain(node.func.value)
+    return None
+
+
+def _is_wallclock(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name not in WALLCLOCK_NAMES:
+        return False
+    recv = _receiver_chain(node)
+    if recv is None:
+        # Bare ``time()``/``now()`` could be a local helper — the
+        # resolver stays quiet.
+        return isinstance(node.func, ast.Name) and name == "time_ns"
+    return recv[-1] in WALLCLOCK_RECEIVERS
+
+
+_SEEDED_RNG_CTORS = frozenset({
+    # Constructing a SEEDED generator through the module is the
+    # sanctioned pattern; only draws from module-global state flag.
+    "Random", "SystemRandom", "default_rng", "Generator", "RandomState",
+})
+
+
+def _is_module_rng(node: ast.Call) -> bool:
+    if call_name(node) in _SEEDED_RNG_CTORS:
+        return False
+    recv = _receiver_chain(node)
+    if not recv:
+        return False
+    if recv == ["random"]:
+        return True
+    if len(recv) >= 2 and recv[-2:] == ["np", "random"]:
+        return True
+    if len(recv) >= 2 and recv[-2:] == ["numpy", "random"]:
+        return True
+    return False
+
+
+def _is_env_read(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name == "getenv":
+            recv = _receiver_chain(node)
+            return recv == ["os"] or recv is None and isinstance(
+                node.func, ast.Name
+            )
+        if name == "get":
+            recv = _receiver_chain(node)
+            return recv == ["os", "environ"]
+        return False
+    if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+        return attr_chain(node.value) == ["os", "environ"]
+    return False
+
+
+class _FunctionScanner:
+    """Taint sites within one function body."""
+
+    def __init__(self, fd, findings: List[Finding]):
+        self.fd = fd
+        self.findings = findings
+        self.set_locals: Set[str] = set()
+        self._collect_set_locals(fd.node)
+
+    def _flag(self, node: ast.AST, what: str, fix: str) -> None:
+        self.findings.append(Finding(
+            PASS_ID, self.fd.rel, node.lineno,
+            f"replay nondeterminism: {what} in {self.fd.qualname} on a "
+            f"replay-reachable path — {fix}",
+        ))
+
+    # -- set-typed local inference -------------------------------------------
+
+    def _is_set_expr(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Set):
+            return True
+        if isinstance(expr, ast.SetComp):
+            return True
+        if isinstance(expr, ast.Call) and call_name(expr) in SET_CTORS:
+            return True
+        if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, SET_BINOPS
+        ):
+            return self._is_set_expr(expr.left) or self._is_set_expr(
+                expr.right
+            )
+        if isinstance(expr, ast.Name):
+            return expr.id in self.set_locals
+        if isinstance(expr, ast.Call) and call_name(expr) in (
+            "union", "intersection", "difference", "symmetric_difference",
+        ):
+            recv = (
+                expr.func.value
+                if isinstance(expr.func, ast.Attribute) else None
+            )
+            return recv is not None and self._is_set_expr(recv)
+        return False
+
+    def _collect_set_locals(self, func_node: ast.AST) -> None:
+        # Two passes so ``a = set(); b = a | other`` resolves.
+        for _ in range(2):
+            for node in ast.walk(func_node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target = node.targets[0]
+                    if isinstance(target, ast.Name) and self._is_set_expr(
+                        node.value
+                    ):
+                        self.set_locals.add(target.id)
+
+    # -- scan ----------------------------------------------------------------
+
+    def scan(self) -> None:
+        # A comprehension handed straight to sorted() is the sanctioned
+        # fix — its generator must not flag.
+        sanctioned: Set[int] = set()
+        for node in ast.walk(self.fd.node):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "sorted"
+            ):
+                for arg in node.args[:1]:
+                    if isinstance(arg, (ast.GeneratorExp, ast.ListComp,
+                                        ast.SetComp)):
+                        sanctioned.add(id(arg))
+        for node in ast.walk(self.fd.node):
+            if isinstance(node, ast.Call):
+                self._scan_call(node)
+            elif isinstance(node, ast.Subscript) and _is_env_read(node):
+                self._flag(
+                    node, "os.environ read",
+                    "read once at startup (or record it in the trace "
+                    "header) so record and replay agree",
+                )
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._scan_iteration(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                if id(node) in sanctioned:
+                    continue
+                for gen in node.generators:
+                    self._scan_iteration(gen.iter)
+
+    def _scan_call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if _is_wallclock(node):
+            self._flag(
+                node, f"wall-clock read {name}()",
+                "replay cannot reproduce it; use the virtual clock / "
+                "cycle counter, or keep it out of verdict-affecting "
+                "state",
+            )
+            return
+        if _is_module_rng(node):
+            self._flag(
+                node, f"module-level RNG call random.{name}()",
+                "use a seeded Generator carried by the harness",
+            )
+            return
+        if _is_env_read(node):
+            self._flag(
+                node, "os.environ read",
+                "read once at startup (or record it in the trace "
+                "header) so record and replay agree",
+            )
+            return
+        if name in ORDERING_CALLS:
+            self._scan_ordering(node)
+        # set.pop() pops an arbitrary element.
+        if name == "pop" and isinstance(node.func, ast.Attribute):
+            if self._is_set_expr(node.func.value) and not node.args:
+                self._flag(
+                    node, "set.pop()",
+                    "pop order is hash order — pop from a sorted list "
+                    "instead",
+                )
+
+    def _scan_ordering(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg != "key":
+                continue
+            key = kw.value
+            uses_id = False
+            if isinstance(key, ast.Name) and key.id == "id":
+                uses_id = True
+            elif isinstance(key, ast.Lambda):
+                for sub in ast.walk(key.body):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "id"
+                    ):
+                        uses_id = True
+                        break
+            if uses_id:
+                self._flag(
+                    node, f"id()-keyed ordering in {call_name(node)}()",
+                    "id order is allocation order — key on a stable "
+                    "field (uid, name) instead",
+                )
+
+    def _scan_iteration(self, iter_expr: ast.AST) -> None:
+        if self._is_set_expr(iter_expr):
+            self._flag(
+                iter_expr, "iteration over an unordered set",
+                "wrap in sorted(...) so record and replay walk the "
+                "same order",
+            )
+
+
+def _reachable(project: Project) -> Set[str]:
+    """Function keys forward-reachable from the root modules."""
+    graph = get_callgraph(project)
+    roots: List[str] = []
+    in_repo = False
+    for key, entry in graph.entries.items():
+        rel = entry.fd.rel.replace("\\", "/")
+        if rel.startswith("kube_batch_tpu/") or rel.startswith("tools/"):
+            in_repo = True
+        if rel.startswith(ROOT_PREFIXES):
+            roots.append(key)
+    if not in_repo:
+        # Fixture/snippet project: every function is a root — the
+        # fixture IS the replay path under test.
+        roots = list(graph.entries)
+    seen: Set[str] = set(roots)
+    frontier = list(roots)
+    while frontier:
+        key = frontier.pop()
+        entry = graph.entries[key]
+        for site in entry.calls:
+            for callee in graph.resolve(entry, site):
+                if callee.fd.key not in seen:
+                    seen.add(callee.fd.key)
+                    frontier.append(callee.fd.key)
+    return seen
+
+
+@register_pass(PASS_ID)
+def run(project: Project) -> List[Finding]:
+    reachable = _reachable(project)
+    findings: List[Finding] = []
+    for pf in project.files:
+        rel = pf.rel.replace("\\", "/")
+        if rel.startswith(EXEMPT_PREFIXES):
+            continue
+        if rel.startswith("tools/") or rel == "bench.py":
+            continue  # drivers run outside the record/replay boundary
+        for fd in iter_functions(pf):
+            if fd.key not in reachable:
+                continue
+            _FunctionScanner(fd, findings).scan()
+    findings.sort(key=lambda f: (f.file, f.line, f.message))
+    return findings

@@ -14,7 +14,16 @@ from __future__ import annotations
 import os
 from typing import Callable, List, Tuple
 
-from . import census, core, dirty_ledger, jit_hygiene, lock_order
+from . import (
+    census,
+    core,
+    dirty_ledger,
+    guarded_by,
+    jit_hygiene,
+    lock_order,
+    replay_det,
+    shape_contracts,
+)
 
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "fixtures")
@@ -55,6 +64,21 @@ def run_selftest() -> List[str]:
         (jit_hygiene.run, "jit_bad.py", "branch on a traced value"),
         (jit_hygiene.run, "jit_bad.py", "host sync"),
         (jit_hygiene.run, "jit_bad.py", "donated-buffer reuse"),
+        (guarded_by.run, "guarded_bad.py", "guarded-by violation"),
+        (replay_det.run, "replay_bad.py", "wall-clock read time()"),
+        (replay_det.run, "replay_bad.py", "module-level RNG"),
+        (replay_det.run, "replay_bad.py", "os.environ read"),
+        (replay_det.run, "replay_bad.py", "iteration over an unordered set"),
+        (replay_det.run, "replay_bad.py", "id()-keyed ordering"),
+        (replay_det.run, "replay_bad.py", "set.pop()"),
+        (shape_contracts.run, "contracts_bad.py",
+         "no entry in the contract table"),
+        (shape_contracts.run, "contracts_bad.py", "stale contract row"),
+        (shape_contracts.run, "contracts_bad.py", "comment declares shape"),
+        (shape_contracts.run, "contracts_bad.py", "_ROW_AXIS says axis"),
+        (shape_contracts.run, "contracts_bad.py",
+         "producer dict never ships it"),
+        (shape_contracts.run, "contracts_bad.py", "out of range"),
     ]
     for pass_fn, fixture, substring in cases:
         findings = pass_fn(_fixture_project(fixture))
@@ -64,6 +88,9 @@ def run_selftest() -> List[str]:
         (lock_order.run, "lock_good.py"),
         (dirty_ledger.run, "ledger_good.py"),
         (jit_hygiene.run, "jit_good.py"),
+        (guarded_by.run, "guarded_good.py"),
+        (replay_det.run, "replay_good.py"),
+        (shape_contracts.run, "contracts_good.py"),
     ]:
         _expect_clean(pass_fn(_fixture_project(fixture)), fixture, failures)
 

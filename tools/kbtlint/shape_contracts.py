@@ -1,0 +1,375 @@
+"""Pass 7: solver tensor shape/dtype contracts (the cross-layer
+shape-drift class, mechanical).
+
+``SolverInputs``/``PackedInputs`` fields flow tensorize →
+device_cache → kernels/topk/sharding/spmd, and every consumer encodes
+the same shape/dtype/stack-layout facts independently: the NamedTuple
+comment (``# i32[T] ...``), the producer's ``np.stack`` dict, the
+device cache's per-field row axis, and constant stack indexing
+(``task_i32[5]``). Today those agree by review; the next new field
+(sharded-sparse slabs, SLO cost rows) has four chances to drift. This
+pass pins them all to ONE declaration table
+(``kube_batch_tpu/solver/contracts.py`` — parsed by AST, never
+imported):
+
+- **field census** — NamedTuple fields vs table keys, both directions,
+  for both bundles;
+- **comment contracts** — each field's ``# dtype[shape]`` trailing
+  comment must parse and match the table (dtype optional in the
+  comment when the field name carries it, e.g. ``task_f32``);
+- **row-axis / donation map** — ``device_cache._ROW_AXIS`` keys and
+  values vs the table's ``row_axis``; every ``donated: True`` field
+  must be patch-eligible (in ``_ROW_AXIS``) and vice versa;
+- **producer census** — the tensorize ``np.stack`` dict literal must
+  produce exactly the packed fields;
+- **stack-index bounds** — ``<recv>.task_i32[K]`` with constant ``K``
+  checked against the declared stack height anywhere in the package
+  (an out-of-range row is a build failure here, not a runtime shape
+  error three layers later).
+
+The runtime twin (``contracts.validate_packed`` /
+``validate_solver_inputs``) checks real arrays against the same table
+with cross-field symbolic-dim binding, armed by
+``KBT_CHECK_CONTRACTS=1`` (sim smoke) and the unit tests.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding, Project, ProjectFile, register_pass
+
+PASS_ID = "shape-contracts"
+
+CONTRACTS_REL_SUFFIX = "solver/contracts.py"
+
+TABLE_NAMES = {
+    "SolverInputs": "SOLVER_INPUT_CONTRACTS",
+    "PackedInputs": "PACKED_INPUT_CONTRACTS",
+}
+
+_COMMENT_RE = re.compile(
+    r"#\s*(?:(f32|f64|i32|i64|bool)\s*)?\[([^\]]*)\]"
+)
+
+
+def _norm_shape(shape) -> Tuple[str, ...]:
+    if isinstance(shape, str):
+        parts = [p.strip() for p in shape.split(",")] if shape.strip() else []
+    else:
+        parts = [str(p) for p in shape]
+    return tuple(p.replace(" ", "") for p in parts)
+
+
+def load_tables(project: Project) -> Tuple[
+    Optional[Dict[str, dict]], Optional[Dict[str, dict]], str, int
+]:
+    """(solver_table, packed_table, rel, line) from the first project
+    file that assigns the table names (solver/contracts.py on the real
+    tree; the fixture itself in snippets)."""
+    for pf in project.files:
+        found: Dict[str, dict] = {}
+        line = 1
+        for node in ast.walk(pf.tree):
+            if not (
+                isinstance(node, ast.Assign) and len(node.targets) == 1
+            ):
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            if target.id in TABLE_NAMES.values():
+                try:
+                    found[target.id] = ast.literal_eval(node.value)
+                except ValueError:
+                    continue
+                line = node.lineno
+        if found:
+            return (
+                found.get("SOLVER_INPUT_CONTRACTS"),
+                found.get("PACKED_INPUT_CONTRACTS"),
+                pf.rel, line,
+            )
+    return None, None, "", 0
+
+
+def _named_tuple_fields(pf: ProjectFile, cls_name: str):
+    """[(field, lineno, source_line)] of one NamedTuple class."""
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            out = []
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    lineno = stmt.lineno
+                    src = (
+                        pf.lines[lineno - 1]
+                        if lineno - 1 < len(pf.lines) else ""
+                    )
+                    out.append((stmt.target.id, lineno, src))
+            return out
+    return None
+
+
+def field_census(
+    cls_name: str, fields: List[str], table: Dict[str, dict],
+    rel: str, line: int,
+) -> List[Finding]:
+    findings = []
+    for name in sorted(set(fields) - set(table)):
+        findings.append(Finding(
+            PASS_ID, rel, line,
+            f"{cls_name} field {name!r} has no entry in the contract "
+            f"table (declare shape/dtype in solver/contracts.py first)",
+        ))
+    for name in sorted(set(table) - set(fields)):
+        findings.append(Finding(
+            PASS_ID, rel, line,
+            f"contract table declares {name!r} but {cls_name} has no "
+            f"such field (stale contract row)",
+        ))
+    return findings
+
+
+def comment_contract_findings(
+    cls_name: str, fields, table: Dict[str, dict], rel: str,
+) -> List[Finding]:
+    findings = []
+    for name, lineno, src in fields:
+        contract = table.get(name)
+        if contract is None:
+            continue  # census already reported it
+        m = _COMMENT_RE.search(src)
+        if m is None:
+            findings.append(Finding(
+                PASS_ID, rel, lineno,
+                f"{cls_name}.{name} has no parseable # dtype[shape] "
+                f"comment contract on its declaration line",
+            ))
+            continue
+        dtype, shape = m.group(1), _norm_shape(m.group(2))
+        want_shape = _norm_shape(contract["shape"])
+        if shape != want_shape:
+            findings.append(Finding(
+                PASS_ID, rel, lineno,
+                f"{cls_name}.{name} comment declares shape "
+                f"[{', '.join(shape)}] but the contract table says "
+                f"[{', '.join(want_shape)}]",
+            ))
+        if dtype is not None and dtype != contract["dtype"]:
+            findings.append(Finding(
+                PASS_ID, rel, lineno,
+                f"{cls_name}.{name} comment declares dtype {dtype} but "
+                f"the contract table says {contract['dtype']}",
+            ))
+    return findings
+
+
+def row_axis_findings(
+    row_axis: Dict[str, int], packed: Dict[str, dict],
+    rel: str, line: int,
+) -> List[Finding]:
+    findings = []
+    for name in sorted(set(row_axis) - set(packed)):
+        findings.append(Finding(
+            PASS_ID, rel, line,
+            f"device-cache _ROW_AXIS patches {name!r} but the contract "
+            f"table has no such packed field",
+        ))
+    for name, contract in sorted(packed.items()):
+        declared = contract.get("row_axis")
+        have = row_axis.get(name)
+        if have is None:
+            findings.append(Finding(
+                PASS_ID, rel, line,
+                f"packed field {name!r} has no _ROW_AXIS entry — the "
+                f"device cache would KeyError on its first delta patch",
+            ))
+        elif declared is not None and have != declared:
+            findings.append(Finding(
+                PASS_ID, rel, line,
+                f"packed field {name!r}: _ROW_AXIS says axis {have} but "
+                f"the contract table declares row_axis {declared} — a "
+                f"patch along the wrong axis scatters rows into the "
+                f"wrong dimension",
+            ))
+        if bool(contract.get("donated")) != (have is not None):
+            findings.append(Finding(
+                PASS_ID, rel, line,
+                f"packed field {name!r}: donation contract "
+                f"(donated={bool(contract.get('donated'))}) disagrees "
+                f"with patch eligibility (_ROW_AXIS "
+                f"{'has' if have is not None else 'lacks'} it)",
+            ))
+    return findings
+
+
+def producer_census(
+    keys: List[str], packed: Dict[str, dict], rel: str, line: int,
+) -> List[Finding]:
+    findings = []
+    for name in sorted(set(keys) - set(packed)):
+        findings.append(Finding(
+            PASS_ID, rel, line,
+            f"tensorize producer ships {name!r} but the contract table "
+            f"has no such packed field",
+        ))
+    for name in sorted(set(packed) - set(keys)):
+        findings.append(Finding(
+            PASS_ID, rel, line,
+            f"packed field {name!r} is declared but the tensorize "
+            f"producer dict never ships it",
+        ))
+    return findings
+
+
+def _find_row_axis(project: Project) -> Tuple[Optional[Dict[str, int]], str, int]:
+    for pf in project.files:
+        for node in ast.walk(pf.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_ROW_AXIS"
+            ):
+                try:
+                    value = ast.literal_eval(node.value)
+                except ValueError:
+                    continue
+                if isinstance(value, dict):
+                    return value, pf.rel, node.lineno
+    return None, "", 0
+
+
+def _find_producer_dict(
+    project: Project, packed: Dict[str, dict]
+) -> Tuple[Optional[List[str]], str, int]:
+    """The tensorize producer: the largest dict literal whose string
+    keys overlap the packed field set by >= 5 names. Config maps
+    (values all constants: _ROW_AXIS) and the contract tables
+    themselves (values all dict literals) are excluded — the
+    declaration must not census itself."""
+    best: Optional[List[str]] = None
+    best_rel, best_line = "", 0
+    for pf in project.files:
+        rel = pf.rel.replace("\\", "/")
+        if rel.startswith("tools/") or rel == "bench.py":
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            keys = [
+                k.value for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            ]
+            if len(keys) != len(node.keys):
+                continue
+            if all(isinstance(v, ast.Constant) for v in node.values):
+                continue  # a config map (_ROW_AXIS), not a producer
+            if all(isinstance(v, ast.Dict) for v in node.values):
+                continue  # a contract table, not a producer
+            overlap = len(set(keys) & set(packed))
+            if overlap >= 5 and (best is None or overlap > len(
+                set(best) & set(packed)
+            )):
+                best, best_rel, best_line = keys, pf.rel, node.lineno
+    return best, best_rel, best_line
+
+
+def stack_index_findings(
+    project: Project, packed: Dict[str, dict]
+) -> List[Finding]:
+    heights = {
+        name: contract["shape"][0]
+        for name, contract in packed.items()
+        if contract["shape"] and isinstance(contract["shape"][0], int)
+    }
+    findings = []
+    for pf in project.files:
+        rel = pf.rel.replace("\\", "/")
+        if rel.startswith("tools/") or rel == "bench.py":
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Subscript):
+                continue
+            if not isinstance(node.value, ast.Attribute):
+                continue
+            name = node.value.attr
+            height = heights.get(name)
+            if height is None:
+                continue
+            index = node.slice
+            if isinstance(index, ast.Tuple) and index.elts:
+                index = index.elts[0]
+            if not (
+                isinstance(index, ast.Constant)
+                and isinstance(index.value, int)
+            ):
+                continue
+            if not -height <= index.value < height:
+                findings.append(Finding(
+                    PASS_ID, pf.rel, node.lineno,
+                    f"stack index {name}[{index.value}] out of range: "
+                    f"the contract table declares a stack height of "
+                    f"{height} (did a new row land without a contract "
+                    f"update?)",
+                ))
+    return findings
+
+
+@register_pass(PASS_ID)
+def run(project: Project) -> List[Finding]:
+    solver_table, packed_table, table_rel, table_line = load_tables(project)
+    findings: List[Finding] = []
+    if solver_table is None and packed_table is None:
+        # Snippet with no table: nothing to check against (the real
+        # tree always carries solver/contracts.py — its absence there
+        # IS a finding).
+        if any(
+            pf.rel.replace("\\", "/").startswith("kube_batch_tpu/")
+            for pf in project.files
+        ):
+            findings.append(Finding(
+                PASS_ID, CONTRACTS_REL_SUFFIX, 1,
+                "contract tables missing: no SOLVER_INPUT_CONTRACTS / "
+                "PACKED_INPUT_CONTRACTS assignment found in the project",
+            ))
+        return findings
+
+    for cls_name, table in (
+        ("SolverInputs", solver_table), ("PackedInputs", packed_table),
+    ):
+        if table is None:
+            continue
+        for pf in project.files:
+            fields = _named_tuple_fields(pf, cls_name)
+            if fields is None:
+                continue
+            findings.extend(field_census(
+                cls_name, [f[0] for f in fields], table, pf.rel,
+                fields[0][1] if fields else 1,
+            ))
+            findings.extend(comment_contract_findings(
+                cls_name, fields, table, pf.rel,
+            ))
+
+    if packed_table is not None:
+        row_axis, ra_rel, ra_line = _find_row_axis(project)
+        if row_axis is not None:
+            findings.extend(row_axis_findings(
+                row_axis, packed_table, ra_rel, ra_line,
+            ))
+        producer, pr_rel, pr_line = _find_producer_dict(
+            project, packed_table
+        )
+        if producer is not None:
+            findings.extend(producer_census(
+                producer, packed_table, pr_rel, pr_line,
+            ))
+        findings.extend(stack_index_findings(project, packed_table))
+
+    findings.sort(key=lambda f: (f.file, f.line, f.message))
+    return findings

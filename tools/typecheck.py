@@ -39,7 +39,16 @@ from typing import Dict, List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASELINE_PATH = os.path.join(REPO, "tools", "typecheck_baseline.json")
-TARGETS = ("kube_batch_tpu/solver", "kube_batch_tpu/cache")
+# Ratchet scope. Widened in order of how much concurrent/new code each
+# layer is about to grow (ISSUE 11): solver+cache (original), then the
+# actions / sim / obs layers the next roadmap items mutate.
+TARGETS = (
+    "kube_batch_tpu/solver",
+    "kube_batch_tpu/cache",
+    "kube_batch_tpu/actions",
+    "kube_batch_tpu/sim",
+    "kube_batch_tpu/obs",
+)
 
 
 def iter_py_files():
