@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke bench-compare verify kbtlint typecheck ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke bench-compare verify kbtlint typecheck ci image clean
 
 all: native
 
@@ -107,6 +107,26 @@ micro-smoke:
 		--faults "solver-exc:0.08,solver-hang:0.02,bind:0.05" \
 		--fail-on-cycle-errors --quiet
 
+# Multi-device sharded-sparse smoke: record a seeded churn run through
+# the SINGLE-device sparse solve (forced K=8), then REPLAY it on >=4
+# simulated host devices with the task-sharded shard_map sparse solve
+# forced (KBT_SPARSE_SHARD_MODE=flat) — the replay verifier compares
+# every cycle's placements byte-for-byte against the recording, so a
+# sharded-vs-single divergence exits 2, and --require-sparse-sharded
+# exits 5 if the sharded path silently never engaged.
+# doc/design/sparse-candidate-solver.md (sharded-solve section).
+shard-smoke:
+	env $(CPU_ENV) KBT_SOLVER=jax $(PY) -m kube_batch_tpu sim \
+		--cycles 40 --seed 5 --backend sparse --topk 8 \
+		--node-churn 0.03 \
+		--trace /tmp/kbt_shard_smoke.jsonl \
+		--fail-on-cycle-errors --quiet
+	env $(CPU_ENV) KBT_SOLVER=jax KBT_SPARSE_SHARD_MODE=flat \
+		$(PY) -m kube_batch_tpu sim --host-devices 4 \
+		--replay /tmp/kbt_shard_smoke.jsonl \
+		--backend sparse --topk 8 \
+		--require-sparse-sharded --fail-on-cycle-errors --quiet
+
 # Bench regression sentinel across the two newest committed bench
 # rounds (noise-aware: canary-normalized thresholds + the explicit
 # allowlist), THEN its own self-test: an injected 20% cycle_ms
@@ -161,7 +181,7 @@ typecheck:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke bench-compare
+ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

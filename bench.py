@@ -761,12 +761,13 @@ def bench_device_cache(cfg="small", seed=0):
     return out
 
 
-def bench_sparse_scale(shape="200000x20000", seed=0):
+def bench_sparse_scale(shape="200000x20000", seed=0, wide_mix=False):
     """Sparse-only scale point: shapes where the DENSE solver is
     arithmetically infeasible — at 200k x 20k one [T, N] f32 score
-    matrix is 16 GB (and the solver materializes mask + score + key per
-    round), so there is nothing to A/B against; the point of this
-    benchmark is that a cycle completes AT ALL.
+    matrix is 16 GB, at 1M x 100k it is 400 GB (and the solver
+    materializes mask + score + key per round), so there is nothing to
+    A/B against; the point of this benchmark is that a cycle completes
+    AT ALL.
 
     Solver inputs are built synthetically at the array level: a 200k-pod
     cache/session build measures Python object churn for minutes and
@@ -774,7 +775,16 @@ def bench_sparse_scale(shape="200000x20000", seed=0):
     identical columnar arrays either way (the 50k headline config covers
     the full-pipeline path). Candidate selection runs the REAL topk pass
     and the solve runs the REAL sparse backend (native when available,
-    else the jitted JAX sparse kernels)."""
+    else the jitted JAX sparse kernels).
+
+    ``wide_mix`` draws requests from a 64x32-value grid instead of the
+    5x5 one (the 1M x 100k point): a million-pod cluster has thousands
+    of distinct pod shapes, and class diversity is what sizes the slab
+    union — with 25 classes x K=64 only 1 600 nodes are ever candidates
+    and the refill stage would drain the other ~97% of tasks at full-N
+    cost, which is a degenerate workload, not a scale measurement. The
+    200k point keeps the original mix so its committed numbers stay
+    comparable."""
     from kube_batch_tpu.solver.kernels import SolverInputs
     from kube_batch_tpu.solver.masks import CombinedMask
     from kube_batch_tpu.solver.topk import select_candidates, topk_config
@@ -782,9 +792,18 @@ def bench_sparse_scale(shape="200000x20000", seed=0):
     T, N = (int(x) for x in shape.lower().split("x"))
     rng = np.random.RandomState(seed)
     R = 2
+    if wide_mix:
+        # ~66% cluster utilisation at 1M x 100k (32-cpu/128Gi nodes):
+        # the scale point measures solver throughput, not a thundering
+        # -herd overload (that regime is the sim's job).
+        cpu_mix = np.linspace(250, 4000, 64).round()
+        mem_mix = np.linspace(256, 16384, 32).round()
+    else:
+        cpu_mix = [250, 500, 1000, 2000, 4000]
+        mem_mix = [256, 512, 1024, 4096, 8192]
     task_req = np.c_[
-        rng.choice([250, 500, 1000, 2000, 4000], T),
-        rng.choice([256, 512, 1024, 4096, 8192], T),
+        rng.choice(cpu_mix, T),
+        rng.choice(mem_mix, T),
     ].astype(np.float32)
     node_idle = np.tile(
         np.asarray([32000.0, 128 * 1024.0], np.float32), (N, 1)
@@ -879,6 +898,120 @@ def bench_sparse_scale(shape="200000x20000", seed=0):
         refill_tasks=int(result.refills),
     )
     return out
+
+
+_SHARDED_AB_SCRIPT = r"""
+import json, time
+import numpy as np
+from kube_batch_tpu.utils.backend import force_cpu_devices
+assert force_cpu_devices(%(devices)d)
+import jax, jax.numpy as jnp
+from kube_batch_tpu.solver import (
+    default_mesh, make_inputs, pad_tasks, solve_sparse_jit,
+    solve_sparse_spmd,
+)
+from kube_batch_tpu.solver.masks import CombinedMask
+from kube_batch_tpu.solver.topk import select_candidates
+
+T, N, K = %(tasks)d, %(nodes)d, 64
+rng = np.random.RandomState(7)
+R = 2
+task_req = np.c_[
+    rng.choice(np.linspace(250, 4000, 64).round(), T),
+    rng.choice(np.linspace(256, 16384, 32).round(), T),
+].astype(np.float32)
+node_idle = np.tile(
+    np.asarray([32000.0, 128 * 1024.0], np.float32), (N, 1)
+)
+eps = np.asarray([10.0, 10.0], np.float32)
+mask = CombinedMask(
+    node_ok=np.ones(N, bool), task_group=np.zeros(T, np.int32),
+    group_rows=np.ones((1, N), bool),
+    pair_idx=np.zeros((0,), np.int32),
+    pair_rows=np.zeros((0, N), bool),
+)
+cs = select_candidates(
+    mask, {}, task_req, task_req, node_idle, node_idle,
+    np.zeros_like(node_idle), np.zeros(N, np.int32),
+    np.zeros(N, np.int32), eps, 1.0, 1.0, K,
+)
+inputs = make_inputs(
+    task_req=jnp.asarray(task_req), task_fit=jnp.asarray(task_req),
+    task_rank=jnp.arange(T, dtype=jnp.int32),
+    task_job=jnp.asarray((np.arange(T) // 10).astype(np.int32)),
+    task_queue=jnp.zeros(T, jnp.int32),
+    node_idle=jnp.asarray(node_idle),
+    node_releasing=jnp.zeros((N, R), jnp.float32),
+    node_cap=jnp.asarray(node_idle),
+    node_task_count=jnp.zeros(N, jnp.int32),
+    node_max_tasks=jnp.zeros(N, jnp.int32),
+    queue_deserved=jnp.full((1, R), jnp.inf, dtype=jnp.float32),
+    queue_allocated=jnp.zeros((1, R), jnp.float32),
+    eps=jnp.asarray(eps),
+    lr_weight=jnp.asarray(1.0, jnp.float32),
+    br_weight=jnp.asarray(1.0, jnp.float32),
+    task_cand=jnp.asarray(cs.task_cand),
+    cand_idx=jnp.asarray(cs.cand_idx),
+    cand_static=jnp.asarray(cs.cand_static),
+    cand_info=jnp.asarray(cs.cand_info),
+)
+mesh = default_mesh()
+out = {"devices": mesh.size, "shape": f"{T}x{N}", "k": K}
+
+def timed(fn, *a, **kw):
+    r = jax.block_until_ready(fn(*a, **kw))  # compile
+    t0 = time.perf_counter()
+    r = fn(*a, **kw)
+    assigned = np.asarray(r.assigned)
+    return (time.perf_counter() - t0) * 1e3, assigned
+
+single_ms, single_a = timed(solve_sparse_jit, inputs)
+padded = pad_tasks(inputs, mesh.size)
+flat_ms, flat_a = timed(solve_sparse_spmd, padded, mesh)
+two_ms, two_a = timed(
+    solve_sparse_spmd, padded, mesh, two_level=True
+)
+out.update(
+    single_ms=round(single_ms, 1),
+    flat_ms=round(flat_ms, 1),
+    two_level_ms=round(two_ms, 1),
+    parity=int((single_a == flat_a[:T]).all()),
+    placed=int((single_a >= 0).sum()),
+    two_level_placed=int((two_a[:T] >= 0).sum()),
+)
+print("SHARDED_AB " + json.dumps(out))
+"""
+
+
+def bench_sharded_vs_single(tasks=65536, nodes=4096, devices=4):
+    """Sharded-vs-single sparse A/B on a forced 4-device host mesh, in
+    a SUBPROCESS (the host device count is frozen at backend init, and
+    the main bench must keep its real topology). On an oversubscribed
+    CPU mesh the shards serialize, so the honest target here is
+    ``parity == 1`` (flat bit-equal to single) and completion of both
+    sharded modes, not wall-clock speedup — the timings exist so
+    committed rounds track the collective overhead trend."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"})
+    env.pop("XLA_FLAGS", None)  # subprocess owns its device count
+    script = _SHARDED_AB_SCRIPT % {
+        "devices": devices, "tasks": tasks, "nodes": nodes,
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=1800, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("SHARDED_AB "):
+            return json.loads(line[len("SHARDED_AB "):])
+    return {
+        "error": f"subprocess exit {proc.returncode}",
+        "stderr": proc.stderr[-2000:],
+    }
 
 
 def bench_sim(cycles=80, seed=11):
@@ -995,6 +1128,12 @@ def main():
         "--shape", default=None, metavar="TxN",
         help="extra sparse-only scale point (e.g. 200000x20000); the "
              "default large run includes 200000x20000 automatically",
+    )
+    ap.add_argument(
+        "--shape-xl", default=None, metavar="TxN",
+        help="headline sparse scale point with the wide class mix "
+             "(default large run: 1000000x100000 — dense [T,N] is 400 "
+             "GB there, completion itself is the result)",
     )
     ap.add_argument(
         "--trace", metavar="PATH", default=None,
@@ -1152,6 +1291,26 @@ def main():
         except Exception as exc:  # pragma: no cover - defensive
             sparse_scale = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # Headline raw-scale point (1M x 100k, wide class mix) + the
+    # sharded-vs-single sparse A/B (subprocess, forced 4-device host
+    # mesh). Both guarded — an OOM or subprocess failure must not lose
+    # the rest of the run.
+    sparse_scale_xl = None
+    xl_shape = args.shape_xl or (
+        "1000000x100000" if headline_cfg == "large" else None
+    )
+    if xl_shape:
+        try:
+            sparse_scale_xl = bench_sparse_scale(xl_shape, wide_mix=True)
+        except Exception as exc:  # pragma: no cover - defensive
+            sparse_scale_xl = {"error": f"{type(exc).__name__}: {exc}"}
+    sharded_vs_single = None
+    if headline_cfg == "large":
+        try:
+            sharded_vs_single = bench_sharded_vs_single()
+        except Exception as exc:  # pragma: no cover - defensive
+            sharded_vs_single = {"error": f"{type(exc).__name__}: {exc}"}
+
     # Long-horizon simulator throughput + invariant-checker overhead
     # (guarded like the other sections).
     try:
@@ -1188,6 +1347,10 @@ def main():
         "solver_sparse": tpu["sparse"],
         "sim": sim,
         **({"sparse_scale": sparse_scale} if sparse_scale else {}),
+        **({"sparse_scale_xl": sparse_scale_xl} if sparse_scale_xl
+           else {}),
+        **({"sharded_vs_single": sharded_vs_single} if sharded_vs_single
+           else {}),
         **extra,
     }))
 

@@ -159,7 +159,8 @@ class AsyncSolveHandle:
     """
 
     __slots__ = (
-        "backend", "rounds", "refills", "stages", "native_stats",
+        "backend", "rounds", "refills", "stages", "reconcile_rounds",
+        "native_stats",
         "_future", "_result", "_assigned", "_error", "_fault_hook",
     )
 
@@ -167,10 +168,12 @@ class AsyncSolveHandle:
         self.backend = backend
         self.rounds = 0
         # Sparse-solve forensics, populated by fetch(): jax path reports
-        # SolverResult.refills/stages (None on a dense solve), native
-        # path snapshots native.greedy.last_solve_stats.
+        # SolverResult.refills/stages (None on a dense solve) and
+        # reconcile_rounds (sharded sparse only), native path snapshots
+        # native.greedy.last_solve_stats.
         self.refills = None
         self.stages = None
+        self.reconcile_rounds = None
         self.native_stats = None
         self._future = None
         self._result = None
@@ -281,6 +284,9 @@ class AsyncSolveHandle:
             self.refills = int(result.refills)
         if result.stages is not None:
             self.stages = int(result.stages)
+        rr = getattr(result, "reconcile_rounds", None)
+        if rr is not None:
+            self.reconcile_rounds = int(rr)
 
     def fetch(self, timeout=None) -> np.ndarray:
         """The block point: the assignment vector as a host array.
@@ -737,9 +743,14 @@ class AllocateTpuAction(Action):
                 refill_rounds = int(handle.stages or 0)
                 last_stats["sparse_refill_tasks"] = handle.refills
             elif tsparse.get("enabled"):
-                # tensorize built slabs but the solve ignored them: the
-                # sharded multi-chip path keeps the dense rounds.
-                fallback_reason = "sharded-mesh"
+                # tensorize built slabs but the final solve ran dense:
+                # a ladder descent stripped them (the sparse rung
+                # failed), or a legacy explicit-staged call ignored
+                # them.
+                fallback_reason = (
+                    "ladder-degraded" if len(ladder) > 1
+                    else "sharded-mesh"
+                )
         if not engaged and fallback_reason is None:
             fallback_reason = tsparse.get("reason")
         last_stats["sparse_engaged"] = engaged
@@ -750,6 +761,27 @@ class AllocateTpuAction(Action):
             last_stats["sparse_fallback_reason"] = fallback_reason
         metrics.update_solver_sparse(engaged, refill_rounds,
                                      fallback_reason)
+        # Sharded-sparse attribution: whether the FINAL successful rung
+        # ran the slab solve sharded over the mesh, under which mode,
+        # and how many cross-shard reconciliation rounds it took
+        # (sharding.last_dispatch reflects the last solve_sharded
+        # dispatch — exactly the winning rung's).
+        from ..solver import sharding as sharding_mod
+
+        disp = sharding_mod.last_dispatch
+        sharded_engaged = bool(
+            engaged and backend != "native"
+            and disp.get("sparse_sharded")
+        )
+        last_stats["sparse_sharded_engaged"] = sharded_engaged
+        if sharded_engaged:
+            last_stats["sparse_shard_mode"] = disp.get("mode")
+            last_stats["sparse_shard_count"] = disp.get("shards")
+            if handle.reconcile_rounds is not None:
+                last_stats["sparse_reconcile_rounds"] = (
+                    handle.reconcile_rounds
+                )
+            metrics.register_sparse_sharded(disp.get("mode"))
         try:
             from ..solver.kernels import jit_compilation_count
 
@@ -989,6 +1021,11 @@ class AllocateTpuAction(Action):
             "sparse_engaged": engaged,
             "sparse_k": tsparse.get("k") if engaged else None,
             "sparse_refill_rounds": refill_rounds if engaged else None,
+            "sparse_sharded": sharded_engaged,
+            "sparse_shard_mode": (
+                last_stats.get("sparse_shard_mode")
+                if sharded_engaged else None
+            ),
             "fallback_reason": fallback_reason,
             "device_bytes_shipped": last_stats.get("device_bytes_shipped"),
             "device_rows_patched": last_stats.get("device_rows_patched"),

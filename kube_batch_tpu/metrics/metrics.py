@@ -54,6 +54,11 @@ class Counter(_Metric):
     def get(self, labels: Tuple = ()) -> float:
         return self._values.get(labels, 0.0)
 
+    def total(self) -> float:
+        """Sum across every label set (engagement asserts in smokes)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def remove(self, labels: Tuple) -> bool:
         """Drop one label set (label GC for deleted subjects — without
         this, per-job series accumulate forever; Prometheus clients
@@ -391,7 +396,7 @@ solver_sparse_dense_fallbacks = REGISTRY.register(
     Counter(
         "solver_sparse_dense_fallbacks_total",
         "Solves that fell back to the dense path by reason "
-        "(class-budget/sharded-mesh/env-disabled)",
+        "(class-budget/sharded-mesh/env-disabled/ladder-degraded)",
     ),
     ("reason",),
 )
@@ -401,6 +406,15 @@ solver_sparse_slab_bytes = REGISTRY.register(
         "Host->device bytes shipped for candidate-slab fields "
         "(cand_idx/cand_static/cand_info) by the snapshot pack",
     )
+)
+solver_sparse_sharded = REGISTRY.register(
+    Counter(
+        "solver_sparse_sharded_solves_total",
+        "Cycles whose sparse solve ran sharded over the device mesh, "
+        "by mode (flat = bit-parity task-sharded shard_map, two-level "
+        "= per-rack solve + global reconciliation)",
+    ),
+    ("mode",),
 )
 # Scheduling-loop robustness + simulator counters (the long-horizon
 # harness in kube_batch_tpu/sim must be observable like everything
@@ -665,7 +679,7 @@ def update_device_cache(stats: dict) -> None:
 # path was wanted but could not run), as opposed to the size policy
 # simply preferring dense on a small problem.
 _SPARSE_FALLBACK_REASONS = frozenset(
-    ("class-budget", "sharded-mesh", "env-disabled")
+    ("class-budget", "sharded-mesh", "env-disabled", "ladder-degraded")
 )
 
 
@@ -679,6 +693,12 @@ def update_solver_sparse(
             solver_sparse_refill_rounds.inc(amount=float(refill_rounds))
     elif fallback_reason in _SPARSE_FALLBACK_REASONS:
         solver_sparse_dense_fallbacks.inc((fallback_reason,))
+
+
+def register_sparse_sharded(mode: str) -> None:
+    """One cycle's sparse solve ran sharded over the mesh (mode =
+    flat | two-level, solver/sharding.sparse_shard_mode)."""
+    solver_sparse_sharded.inc((mode or "unknown",))
 
 
 def update_solver_jit_cache(count: int) -> None:

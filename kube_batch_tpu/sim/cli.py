@@ -3,7 +3,10 @@
 Exit codes: 0 clean; 1 invariant violations (always — a sim run that
 breaks the contract must fail CI); 2 replay placement mismatch;
 3 scheduler-cycle errors with ``--fail-on-cycle-errors``; 4 soak-mode
-leak/drift detector trip (``--soak``).
+leak/drift detector trip (``--soak``); 5 the sharded-sparse engagement
+assert failed (``--require-sparse-sharded`` — the run never solved
+through the multi-device sparse path, or ``--host-devices`` could not
+re-shape an already-initialized backend).
 """
 
 from __future__ import annotations
@@ -81,6 +84,15 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
         help="event-driven micro-cycle mode: run the full periodic "
              "cycle only every Nth sim cycle and the bounded warm-path "
              "micro cycle in between (0 disables)")
+    parser.add_argument(
+        "--host-devices", type=int, default=0, metavar="N",
+        help="force >=N virtual CPU host devices before the first "
+             "backend resolution (multi-device sharding smokes)")
+    parser.add_argument(
+        "--require-sparse-sharded", action="store_true",
+        help="exit 5 unless at least one cycle's sparse solve ran "
+             "sharded over the device mesh "
+             "(solver_sparse_sharded_solves_total)")
     parser.add_argument("--quiet", action="store_true",
                         help="suppress the JSON report on stdout")
 
@@ -131,6 +143,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     add_sim_flags(parser)
     ns = parser.parse_args(argv)
+    if ns.host_devices:
+        # Must precede ANY backend resolution (the harness's first
+        # solve); re-shaping after a client exists is impossible.
+        from ..utils.backend import force_cpu_devices
+
+        if not force_cpu_devices(ns.host_devices):
+            print(
+                f"sim: --host-devices {ns.host_devices} requested but a "
+                "backend with fewer devices is already initialized",
+                file=sys.stderr,
+            )
+            return 5
     cfg = config_from_args(ns)
 
     sim = ClusterSimulator(cfg)
@@ -141,6 +165,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     out["backend"] = cfg.backend
     out["faults"] = cfg.faults
     out["replayed"] = cfg.replay is not None
+    sharded_solves = None
+    if ns.require_sparse_sharded:
+        from .. import metrics
+
+        sharded_solves = int(metrics.solver_sparse_sharded.total())
+        out["sparse_sharded_solves"] = sharded_solves
     if not ns.quiet:
         print(json.dumps(out, indent=2, sort_keys=True))
 
@@ -172,4 +202,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         for hint in report.soak.get("replay_bisect", []):
             print(f"sim:   {hint}", file=sys.stderr)
         return 4
+    if ns.require_sparse_sharded and not sharded_solves:
+        print(
+            "sim: no cycle solved through the sharded sparse path "
+            "(--require-sparse-sharded)",
+            file=sys.stderr,
+        )
+        return 5
     return 0

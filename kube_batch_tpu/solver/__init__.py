@@ -33,12 +33,19 @@ from .sharding import (
     default_mesh,
     init_distributed,
     pad_nodes,
+    pad_tasks,
     sharded_step,
     shardings_for,
     solve_sharded,
+    sparse_shard_mode,
 )
 from .snapshot import ResourceLayout, SnapshotContext, tensorize
-from .spmd import solve_spmd, spmd_shardings_for
+from .spmd import (
+    solve_sparse_spmd,
+    solve_spmd,
+    sparse_spmd_shardings_for,
+    spmd_shardings_for,
+)
 
 __all__ = [
     "PackedInputs",
@@ -61,9 +68,13 @@ __all__ = [
     "less_equal",
     "make_inputs",
     "pad_nodes",
+    "pad_tasks",
     "segmented_cumsum",
     "sharded_step",
     "shardings_for",
+    "sparse_shard_mode",
+    "sparse_spmd_shardings_for",
+    "solve_sparse_spmd",
     "solve",
     "solve_auto",
     "solve_full_jit",

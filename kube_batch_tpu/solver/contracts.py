@@ -105,6 +105,30 @@ PACKED_INPUT_CONTRACTS = {
                     "row_axis": 1, "donated": True, "optional": True},
 }
 
+# -- sharded-solve partition contracts ---------------------------------------
+# Which dim of each SolverInputs field the multi-device solvers
+# partition over the 1-D mesh; fields absent from a table are
+# replicated VALUES under that solver. Pure literals: kbtlint's
+# shape-contracts pass checks every key against SOLVER_INPUT_CONTRACTS
+# and every dim index against the declared rank, and solver/spmd.py
+# derives its shard_map specs from these tables — one source of truth
+# for "what is sharded where".
+#
+# Dense SPMD (solver/spmd.py:_solve_spmd_local): node COLUMNS sharded,
+# node/queue tables and task vectors replicated.
+DENSE_SPMD_SHARD_DIMS = {
+    "node_feas": 0,
+    "group_feas": 1,
+    "pair_feas": 1,
+    "score_rows": 1,
+}
+# Sharded SPARSE solve (solver/spmd.py:_solve_sparse_spmd_local):
+# every INPUT field is a replicated value — the task axis partitions
+# the DERIVED per-task slab expansions ([T, K] candidate ids/keys and
+# [T, K, R] idle gathers) inside the shard_map body, which is where
+# the memory that grows with T·K actually lives.
+SPARSE_SHARD_DIMS = {}
+
 CHECK_CONTRACTS_ENV = "KBT_CHECK_CONTRACTS"
 
 _DTYPE_NAMES = {

@@ -140,13 +140,21 @@ class DeviceSnapshotCache:
     attribute), giving it exactly the lifetime of the mirror it
     shadows — same pattern as ``snapshot._TensorizeCache``."""
 
-    __slots__ = ("host", "dev")
+    __slots__ = ("host", "dev", "layout_token", "placement")
 
     def __init__(self):
         # field -> exact host copy of what is resident on device
         self.host: Dict[str, np.ndarray] = {}
         # field -> jax.Array resident buffer
         self.dev: Dict[str, object] = {}
+        # Solver device-layout key (sharding.packed_sparse_placement):
+        # resident buffers are only reusable under the layout they were
+        # placed for — a mesh/mode flip voids them all (labeled
+        # ``mesh-change`` full re-upload).
+        self.layout_token = None
+        # jax.sharding.Sharding applied at upload time (None = default
+        # single-device placement).
+        self.placement = None
 
     def drop(self) -> None:
         """Release every resident buffer (shutdown / tests)."""
@@ -168,7 +176,12 @@ class DeviceSnapshotCache:
     def _upload(self, name: str, arr: np.ndarray, reason: str, stats):
         import jax.numpy as jnp
 
-        dev = jnp.asarray(arr)
+        if self.placement is not None:
+            import jax
+
+            dev = jax.device_put(arr, self.placement)
+        else:
+            dev = jnp.asarray(arr)
         self.host[name] = arr
         self.dev[name] = dev
         stats["uploads"] += 1
@@ -207,12 +220,21 @@ class DeviceSnapshotCache:
         stats["field_outcomes"][name] = "patch"
         return dev
 
-    def pack(self, arrays: Dict[str, np.ndarray]):
+    def pack(self, arrays: Dict[str, np.ndarray],
+             placement: Optional[object] = None,
+             layout_token: Optional[str] = None):
         """Build a :class:`~.kernels.PackedInputs` from stacked host
         arrays, reusing/patching resident device buffers per field (see
         module docstring for the reuse/patch/upload decision). Records
         per-cycle forensics in :data:`last_pack_stats` and exports the
-        aggregate counters through ``metrics``."""
+        aggregate counters through ``metrics``.
+
+        ``placement``/``layout_token`` parameterize residency by the
+        solver's device layout (sharding.packed_sparse_placement): a
+        token change drops every resident buffer — a buffer laid out
+        for one mesh/mode cannot be patched into another — and the
+        whole snapshot re-uploads under the new placement, labeled
+        ``mesh-change``."""
         from .kernels import PackedInputs
 
         if contracts_enabled():
@@ -232,6 +254,14 @@ class DeviceSnapshotCache:
             "full_reasons": {},
             "field_outcomes": {},
         }
+        cold_reason = "cold"
+        if layout_token != self.layout_token:
+            if self.host:
+                self.drop()
+                cold_reason = "mesh-change"
+                stats["layout_change"] = True
+            self.layout_token = layout_token
+        self.placement = placement
         fields: Dict[str, object] = {}
         for name, arr in arrays.items():
             stats["bytes_total"] += arr.nbytes
@@ -239,7 +269,7 @@ class DeviceSnapshotCache:
             cached = self.host.get(name)
             dev = self.dev.get(name)
             if cached is None or dev is None:
-                fields[name] = self._upload(name, arr, "cold", stats)
+                fields[name] = self._upload(name, arr, cold_reason, stats)
             elif cached.shape != arr.shape or cached.dtype != arr.dtype:
                 fields[name] = self._upload(
                     name, arr, "shape-change", stats

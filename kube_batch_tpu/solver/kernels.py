@@ -251,6 +251,9 @@ class SolverResult(NamedTuple):
     refills: jnp.ndarray = None   # i32[] tasks routed to candidate refill
                                   # (sparse only; stages counts the refill
                                   # rounds those tasks then ran)
+    reconcile_rounds: jnp.ndarray = None  # i32[] cross-shard reconciliation
+                                  # rounds (sharded sparse only: global
+                                  # commit-collective rounds, spmd.py)
 
 
 def less_equal(a: jnp.ndarray, b: jnp.ndarray, eps: jnp.ndarray) -> jnp.ndarray:

@@ -16,6 +16,8 @@ by ``__graft_entry__.dryrun_multichip`` (the driver's multi-chip check).
 from __future__ import annotations
 
 import functools
+import os
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +37,23 @@ _NODE_MAJOR = (
 _NODE_MINOR = ("group_feas", "pair_feas", "score_rows")
 # PackedInputs stacks node tables as [k, N, ...]: node axis is axis 1.
 _PACKED_NODE_MINOR = ("node_f32", "node_i32") + _NODE_MINOR
+
+
+def _distributed_initialized() -> bool:
+    """Version-tolerant "has jax.distributed.initialize already run"
+    probe: jax >= 0.5 exposes ``is_initialized``; 0.4.x keeps the
+    coordinator handle on the private distributed state (API drift the
+    seed inherited — a missing probe here crashed every multi-host
+    join attempt on 0.4.x with AttributeError)."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.coordinator_address is not None
+    except Exception:  # pragma: no cover - further private-API drift
+        return False
 
 
 def init_distributed(coordinator_address=None, num_processes=None,
@@ -57,8 +76,6 @@ def init_distributed(coordinator_address=None, num_processes=None,
     Parameters default to the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES
     / JAX_PROCESS_ID environment (the jax.distributed convention). No-op
     when no coordinator is configured (single-host mode)."""
-    import os
-
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )
@@ -66,7 +83,7 @@ def init_distributed(coordinator_address=None, num_processes=None,
         return False
     # Idempotent: a retry path or second defensive join must not crash
     # (jax.distributed.initialize raises if called twice).
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return True
     if num_processes is None:
         env_n = os.environ.get("JAX_NUM_PROCESSES", "")
@@ -154,7 +171,140 @@ def pad_nodes(inputs, multiple: int):
     repl.update(
         {f: pad_axis(getattr(inputs, f), 1) for f in _NODE_MINOR}
     )
+    if getattr(inputs, "cand_idx", None) is not None:
+        # Candidate slabs use an invalid-node sentinel >= N; after
+        # padding, the old sentinel value would alias a (padded, empty)
+        # REAL row, so move it past the new node count.
+        repl["cand_idx"] = jnp.where(
+            inputs.cand_idx >= n, n + pad, inputs.cand_idx
+        )
     return inputs._replace(**repl)
+
+
+def pad_tasks(inputs: SolverInputs, multiple: int) -> SolverInputs:
+    """Pad the TASK axis of a SolverInputs bundle up to a multiple of
+    ``multiple`` so the sharded sparse solve's row blocks are even.
+    Padded rows are invalid (``task_valid`` False), carry no resources,
+    isolated job ids, and INT_MAX ranks, so no solver path can act on
+    them — callers slice ``assigned[:T]`` back.
+
+    On the production path this is an identity for power-of-two
+    meshes: ``tensorize`` buckets the task axis to multiples of
+    256/2048 (snapshot._task_bucket)."""
+    T = inputs.task_req.shape[0]
+    pad = (-T) % multiple
+    if pad == 0:
+        return inputs
+
+    def pad_axis0(x: jnp.ndarray) -> jnp.ndarray:
+        widths = [(0, 0)] * x.ndim
+        widths[0] = (0, pad)
+        return jnp.pad(x, widths)
+
+    repl = {
+        f: pad_axis0(getattr(inputs, f))
+        for f in (
+            "task_req", "task_fit", "task_queue", "task_group",
+            "task_valid",
+        )
+    }
+    repl["task_rank"] = jnp.concatenate([
+        jnp.asarray(inputs.task_rank),
+        jnp.full((pad,), jnp.iinfo(jnp.int32).max, jnp.int32),
+    ])
+    # Isolated job ids: padded rows must never join a real job's
+    # segment reductions.
+    repl["task_job"] = jnp.concatenate([
+        jnp.asarray(inputs.task_job),
+        jnp.arange(T, T + pad, dtype=jnp.int32),
+    ])
+    if getattr(inputs, "task_cand", None) is not None:
+        repl["task_cand"] = pad_axis0(inputs.task_cand)
+    return inputs._replace(**repl)
+
+
+# ---------------------------------------------------------------------------
+# Sharded-sparse dispatch policy + layout tokens (PR 12).
+# ---------------------------------------------------------------------------
+
+# Below this task count the single-device sparse jit wins outright: the
+# slab rounds do O(T·K) work with no [T, N] structures, and the sharded
+# path pays two collectives per commit; the crossover mirrors the
+# existing K·s<N rationale for keeping slab inputs off the dense mesh.
+_SPARSE_SHARD_MIN_TASKS = 1 << 16
+# Past this task count (and a >=4-device mesh) the per-commit
+# collective cadence itself dominates and the policy moves to the
+# two-level per-rack solve (collective-free local phase, one psum
+# reconcile) — quality-approximate, so deliberately far past every
+# parity-suite shape.
+_TWO_LEVEL_MIN_TASKS = 1 << 19
+
+# Forensics of the most recent solve_sharded dispatch (mode, shard
+# count, engagement), read by actions.allocate_tpu for
+# last_stats/metrics attribution. Single-threaded by construction,
+# like device_cache.last_pack_stats.
+last_dispatch: dict = {}
+
+# Device count witnessed by the first sharded dispatch — process
+# -constant once set (a jax process cannot change its device set), and
+# deliberately NEVER probed outside a solve path: jax.devices() on a
+# wedged tunnel can hang, and warm-plan/native paths must not take
+# that risk (see prospective_layout_token).
+_layout_state: dict = {"devices": None}
+
+
+def sparse_shard_mode(n_tasks: int, mesh: Optional[Mesh]) -> str:
+    """Resolve the sharded-sparse dispatch mode for a snapshot:
+    ``single`` (single-device sparse jit), ``flat`` (task-sharded
+    shard_map, bit-equal to single), or ``two-level`` (per-rack solve +
+    global reconciliation, quality-approximate). ``KBT_SPARSE_SHARD_MODE``
+    forces a mode (``off``/``single``, ``flat``, ``two-level``); unset
+    = the shape policy above."""
+    if mesh is None or mesh.size < 2:
+        return "single"
+    raw = os.environ.get("KBT_SPARSE_SHARD_MODE", "").strip().lower()
+    if raw in ("off", "single", "0", "disable", "disabled"):
+        return "single"
+    if raw in ("flat", "1", "force"):
+        return "flat"
+    if raw in ("two-level", "two_level", "2", "hierarchical"):
+        return "two-level"
+    if n_tasks < _SPARSE_SHARD_MIN_TASKS:
+        return "single"
+    if n_tasks >= _TWO_LEVEL_MIN_TASKS and mesh.size >= 4:
+        return "two-level"
+    return "flat"
+
+
+def prospective_layout_token() -> Optional[str]:
+    """The solver layout a solve dispatched NOW would run under, or
+    None when no sharded dispatch has happened yet (device count
+    unknown — probing it here could hang on a wedged backend, and a
+    process that never solved on a device has no layout to drift
+    from). Consumed by the warm-start plan: a token change voids
+    carried verdicts with the labeled ``mesh-changed`` fallback."""
+    n = _layout_state["devices"]
+    if n is None:
+        return None
+    mode = os.environ.get("KBT_SPARSE_SHARD_MODE", "").strip().lower()
+    return f"{n}dev:{mode or 'auto'}"
+
+
+def packed_sparse_placement(n_tasks: int) -> Tuple[Optional[NamedSharding], str]:
+    """Device placement + layout token for the packed snapshot
+    (consumed by tensorize → device_cache.pack): when the sharded
+    sparse path will run, resident buffers are uploaded REPLICATED on
+    the mesh so the jitted shard_map step never re-lays them out per
+    cycle; otherwise None (default single-device placement). The token
+    keys the device cache's residency — a layout flip forces a full
+    labeled re-upload."""
+    mesh = default_mesh()
+    size = mesh.size if mesh is not None else 1
+    mode = sparse_shard_mode(n_tasks, mesh) if n_tasks else "single"
+    token = f"{size}dev:{mode}"
+    if mesh is None or mode == "single":
+        return None, token
+    return NamedSharding(mesh, P()), token
 
 
 # Weakrefs to jitted GSPMD steps for the retrace census (see
@@ -199,6 +349,69 @@ def _staged_for_shape(inputs, staged):
     return N >= _STAGED_MIN_NODES and T >= _STAGED_MIN_TASKS
 
 
+def _slab_classes(inputs) -> int:
+    """Candidate-class count of an inputs bundle (0 = dense)."""
+    cand = getattr(inputs, "cand_idx", None)
+    return int(cand.shape[0]) if cand is not None else 0
+
+
+def _task_count(inputs) -> int:
+    if isinstance(inputs, PackedInputs):
+        return int(inputs.task_f32.shape[1])
+    return int(inputs.task_req.shape[0])
+
+
+def _node_count(inputs) -> int:
+    if isinstance(inputs, PackedInputs):
+        return int(inputs.node_f32.shape[1])
+    return int(inputs.node_idle.shape[0])
+
+
+def _note_dispatch(mode: str, shards: int, reason: str = None) -> None:
+    last_dispatch.clear()
+    last_dispatch.update(
+        mode=mode,
+        shards=shards,
+        sparse_sharded=mode in ("flat", "two-level"),
+    )
+    if reason:
+        last_dispatch["reason"] = reason
+    # First dispatch pins the process's device count for the warm
+    # plan's layout token (jax is live here by definition).
+    if _layout_state["devices"] is None:
+        _layout_state["devices"] = jax.device_count()
+
+
+def _sparse_sharded_step(inputs, mesh: Mesh, mode: str, max_rounds,
+                         tail_bucket):
+    """(step, device_inputs) for the task-sharded sparse solve: pad
+    the task axis (and node axis for two-level) to the mesh multiple,
+    device_put replicated, hand back the cached jitted step."""
+    from .spmd import _spmd_sparse_step, sparse_spmd_shardings_for
+
+    if not isinstance(inputs, PackedInputs):
+        inputs = pad_tasks(inputs, mesh.size)
+        if mode == "two-level":
+            inputs = pad_nodes(inputs, mesh.size)
+    elif _task_count(inputs) % mesh.size or (
+        mode == "two-level" and _node_count(inputs) % mesh.size
+    ):
+        # A silent mis-split would simply never solve the remainder
+        # rows; refuse loudly (solve_sharded routes ragged packed
+        # bundles to the single-device jit before ever getting here).
+        raise ValueError(
+            f"sparse sharded solve needs task{'/node' if mode == 'two-level' else ''} "
+            f"axes divisible by the mesh size {mesh.size}"
+        )
+    inputs = jax.device_put(
+        inputs, sparse_spmd_shardings_for(inputs, mesh)
+    )
+    step = _spmd_sparse_step(
+        mesh, max_rounds, tail_bucket, mode == "two-level"
+    )
+    return step, inputs
+
+
 def sharded_step(
     inputs,
     mesh: Mesh,
@@ -217,7 +430,29 @@ def sharded_step(
     replicated, per-commit communication limited to a two-[T]-vector
     all_gather. ``impl='gspmd'`` keeps the legacy auto-partitioned
     single-device program (collective-dominated at scale; retained for
-    A/B and as the fallback surface)."""
+    A/B and as the fallback surface). Candidate-slab inputs route to
+    the task-sharded SPARSE step when the shape/mesh policy engages it
+    (``impl='sparse'`` forces flat, ``'sparse-two-level'`` the
+    hierarchical mode)."""
+    sparse_mode = None
+    if impl == "sparse":
+        sparse_mode = "flat"          # forced: ALWAYS the bit-parity mode
+    elif impl == "sparse-two-level":
+        sparse_mode = "two-level"
+    elif impl == "spmd" and staged is None and _slab_classes(inputs) > 0:
+        mode = sparse_shard_mode(_task_count(inputs), mesh)
+        ragged = isinstance(inputs, PackedInputs) and (
+            _task_count(inputs) % mesh.size
+            or (mode == "two-level" and _node_count(inputs) % mesh.size)
+        )
+        if mode != "single" and not ragged:
+            # Ragged packed axes keep the pre-existing dense-sharded
+            # behavior (same graceful shape handling as solve_sharded).
+            sparse_mode = mode
+    if sparse_mode is not None:
+        return _sparse_sharded_step(
+            inputs, mesh, sparse_mode, max_rounds, tail_bucket
+        )
     inputs = pad_nodes(inputs, mesh.size)
     if impl == "spmd":
         from .spmd import _spmd_step, spmd_shardings_for
@@ -253,23 +488,52 @@ def solve_sharded(
     (default) or the legacy GSPMD auto-partitioning (see
     :func:`sharded_step`).
 
-    Candidate-sparsified inputs (topk slabs present) always take the
-    single-device sparse jit, mesh or not: the slab rounds do O(T·K)
-    work and materialize no [T, N] structures, so one device running
-    the sparse program beats N/s-sharded dense rounds whenever
-    K·s < N (the production regime), while candidate gathers inside
-    shard_map would force per-round cross-shard node-row collectives.
-    The sharded SPMD solvers remain the dense scale path.
+    Candidate-sparsified inputs (topk slabs present) dispatch through
+    :func:`sparse_shard_mode`: at parity-suite scale the single-device
+    sparse jit wins outright (the slab rounds do O(T·K) work with no
+    [T, N] structures — one device beats N/s-sharded dense whenever
+    K·s < N), so ``single`` stays the small-shape default; past the
+    policy floor the task-sharded shard_map sparse solve (bit-equal
+    ``flat``, or the Tesserae-style ``two-level``) takes over.
+    ``KBT_SPARSE_SHARD_MODE`` forces a mode. The dense SPMD solvers
+    remain the dense scale path.
     """
     if mesh is None:
         mesh = default_mesh()
+    noted = False
     if mesh is not None and staged is None:
         # Shape probe only — no unpack() (its eager per-field slices
         # cost real milliseconds outside a jit).
-        cand = getattr(inputs, "cand_idx", None)
-        if cand is not None and cand.shape[0] > 0:
+        if _slab_classes(inputs) > 0:
+            T = _task_count(inputs)
+            mode = sparse_shard_mode(T, mesh)
+            reason = None
+            if mode != "single" and isinstance(inputs, PackedInputs):
+                # A packed bundle cannot be re-padded without defeating
+                # device residency; production buckets divide every
+                # pow2 mesh, so ragged axes are a test/tool corner —
+                # fall back to the single-device jit, labeled.
+                if T % mesh.size or (
+                    mode == "two-level"
+                    and _node_count(inputs) % mesh.size
+                ):
+                    mode, reason = "single", "ragged-axes"
+            _note_dispatch(mode, mesh.size, reason)
+            noted = True
+            if mode != "single":
+                step, dev_inputs = _sparse_sharded_step(
+                    inputs, mesh, mode, max_rounds, tail_bucket
+                )
+                result = step(dev_inputs)
+                if int(result.assigned.shape[0]) != T:
+                    result = result._replace(
+                        assigned=result.assigned[:T]
+                    )
+                return result
             mesh = None
     if mesh is None:
+        if not noted:
+            _note_dispatch("single", 1)
         # Single device: reuse the module-level cached jits.
         from .kernels import solve_full_jit, solve_jit, solve_staged_jit
 
@@ -281,6 +545,7 @@ def solve_sharded(
             )
         return solve_full_jit(inputs, max_rounds=max_rounds)
 
+    _note_dispatch(f"dense-{impl}", mesh.size)
     step, inputs = sharded_step(
         inputs, mesh, max_rounds=max_rounds, staged=staged,
         tail_bucket=tail_bucket, impl=impl,

@@ -1185,10 +1185,20 @@ def tensorize(
         "cand_info": cand_info,
     }
     from .device_cache import device_cache_of
+    from .sharding import packed_sparse_placement
 
+    # Device placement for the sharded sparse path: when the shape/mesh
+    # policy will shard this snapshot's solve, resident buffers upload
+    # replicated on the mesh ONCE so the shard_map step never re-lays
+    # them out per cycle; the token keys residency to the layout.
+    placement, layout_token = packed_sparse_placement(
+        Tp if cand_sel is not None else 0
+    )
     dc = device_cache_of(ssn.cache)
     if dc is not None:
-        return dc.pack(stacked), ctx
+        return dc.pack(
+            stacked, placement=placement, layout_token=layout_token
+        ), ctx
     import jax.numpy as jnp
 
     inputs = PackedInputs(

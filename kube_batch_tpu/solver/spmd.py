@@ -46,6 +46,7 @@ util/scheduler_helper.go:84,137 — itself a shard-the-node-axis design.
 from __future__ import annotations
 
 import functools
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +69,7 @@ from .kernels import (
     SolverInputs,
     SolverResult,
     _commit_bids,
+    _dense_tail,
     _dyn_score_core,
     CPU_DIM,
     MEM_DIM,
@@ -83,12 +85,15 @@ NODE_AXIS = "nodes"
 
 # SolverInputs fields carrying node COLUMNS (sharded); node TABLES
 # (idle/cap/releasing/counts) stay replicated — they are O(N*R) small and
-# the replicated commit updates them identically on every shard.
+# the replicated commit updates them identically on every shard. The
+# field → sharded-dim declaration lives in solver/contracts.py
+# (DENSE_SPMD_SHARD_DIMS, cross-checked by kbtlint's shape-contracts
+# pass); this derives the PartitionSpecs from it.
+from .contracts import DENSE_SPMD_SHARD_DIMS as _DENSE_SHARD_DIMS
+
 _SHARDED_SPECS = {
-    "node_feas": P(NODE_AXIS),
-    "group_feas": P(None, NODE_AXIS),
-    "pair_feas": P(None, NODE_AXIS),
-    "score_rows": P(None, NODE_AXIS),
+    f: P(*([None] * dim + [NODE_AXIS]))
+    for f, dim in _DENSE_SHARD_DIMS.items()
 }
 
 INT_MAX = 2**31 - 1
@@ -710,3 +715,545 @@ def solve_spmd(
     bit-exact. Node axis must be padded to a multiple of ``mesh.size``
     (sharding.pad_nodes; the production tensorize buckets N to 128s)."""
     return _spmd_step(mesh, staged, max_rounds, tail_bucket)(inputs)
+
+
+# ---------------------------------------------------------------------------
+# Sharded SPARSE solve: slab rows over devices (PR 12).
+#
+# The dense SPMD solvers above shard the NODE axis because every dense
+# intermediate is [T, N]. The candidate-sparsified solve has no [T, N]
+# structure at all — its round-dominating tensors are the per-TASK slab
+# expansions ([T, K] candidate ids/keys and the [T, K, R] idle gathers)
+# — so the scale axis to partition is the TASK axis. Each shard owns a
+# contiguous block of T/s slab rows and runs the O(T·K/s) mask → score
+# → integer-key → per-row argmax work locally; because every one of
+# those computations is ROW-independent, the local block computes
+# bit-exactly what the single-device kernel computes for the same rows.
+# The only cross-task computation in the sparse solver is conflict
+# resolution: bids carry GLOBAL node ids, so `_commit_bids`' dense [N]
+# capacity accounting becomes the per-commit cross-shard collective —
+# one all_gather assembles the full [T] bid vector (s·T·4 bytes, never
+# [T, K]), shard 0 runs the sort-based commit against the replicated
+# node/queue tables, and one psum broadcasts the packed result (the
+# same shard-0-commit rationale as `_spmd_round`: replicated commit
+# compute is free on real parallel chips but multiplies wall time by
+# the shard count on an oversubscribed/emulated mesh). Exhaustion
+# verdicts gather the same way once per round, so failed/refill/
+# job-break state stays replicated [T] and exactly mirrors
+# `_sparse_round`'s update order. Refill-flagged tasks drain through
+# the SAME `_dense_tail` stage the single-device sparse solve uses —
+# run on shard 0 against the replicated full inputs and broadcast —
+# which is what makes the whole path bit-equal to `solve_sparse`.
+#
+# All INPUT fields stay replicated values (task vectors are O(T) small;
+# the class-level [C, K] slabs are KB-scale): only the derived per-task
+# expansions — the memory that actually grows with T·K — are sharded,
+# by never materializing more than the local block of them. The
+# declared layout lives in solver/contracts.py (SPARSE_SHARD_DIMS).
+#
+# The TWO-LEVEL mode (Tesserae, PAPERS.md: scalable placement policies
+# decompose into per-sub-cluster solves reconciled globally) trades the
+# per-commit collective for collective-FREE local solves: the node
+# space splits into s contiguous racks (rack i = rows [i·N/s, (i+1)·N/s)),
+# shard i solves its task block against ONLY its rack's candidate
+# columns and a 1/s headroom slice of every queue budget — disjoint
+# node ownership means zero cross-shard capacity conflicts and the
+# budget slice means no global queue overshoot — then one psum of the
+# state DELTAS reconciles exactly (disjoint rows sum losslessly), and
+# the leftovers (tasks whose rack columns were full or infeasible)
+# drain through the flat rounds + dense tail above as the global
+# reconciliation. Placement quality approximates the global solve
+# (documented in doc/design/sparse-candidate-solver.md); node/queue
+# invariants are preserved exactly because every accept still goes
+# through `_commit_bids`. Two-level is NOT bit-equal to the
+# single-device solve — the shape policy (sharding.sparse_shard_mode)
+# only selects it far past the parity-suite shapes.
+# ---------------------------------------------------------------------------
+
+
+def sparse_spmd_shardings_for(inputs: Any, mesh: Mesh) -> Any:
+    """Device-put layout for the sharded sparse solve: every input
+    field replicated over the mesh (None-able fields mirror as None so
+    device_put treedefs match), per contracts.SPARSE_SHARD_DIMS. The
+    [T, K] slab expansions shard inside the shard_map body by
+    construction — they are derived, never shipped."""
+    from jax.sharding import NamedSharding
+
+    from .contracts import SPARSE_SHARD_DIMS
+
+    axis = mesh.axis_names[0]
+    rep = NamedSharding(mesh, P())
+    by_field = {
+        f: NamedSharding(mesh, P(*([None] * dim + [axis])))
+        for f, dim in SPARSE_SHARD_DIMS.items()
+    }
+    cls = type(inputs)
+    return cls(**{
+        f: (
+            None if getattr(inputs, f, None) is None
+            else by_field.get(f, rep)
+        )
+        for f in cls._fields
+    })
+
+
+def _pack_commit(assigned, idle, ntask, qalloc, acc):
+    """Pack one commit's state into (i32, f32) psum buffers."""
+    return (
+        jnp.concatenate([assigned, ntask, acc.astype(jnp.int32)[None]]),
+        jnp.concatenate([idle.ravel(), qalloc.ravel()]),
+    )
+
+
+def _slab_mask(task_fit_l, idle, ntask, node_max_tasks, cand_nodes_l,
+               col_ok_l, task_ok_l, eps):
+    """[Tl, K] slab eligibility for one sharded round: fit against
+    CURRENT idle, pod-count caps, column validity, row gate. ONE
+    definition shared by the flat and two-level rounds — this is the
+    gating whose exactness the bit-parity contract depends on (mirrors
+    kernels._sparse_round's mask construction verbatim). Returns
+    (mask_l, idle_slab, safe_l)."""
+    N = idle.shape[0]
+    cap_ok = (node_max_tasks == 0) | (ntask < node_max_tasks)
+    safe_l = jnp.minimum(cand_nodes_l, N - 1)
+    idle_slab = idle[safe_l]                             # [Tl, K, R]
+    fits_l = less_equal(task_fit_l[:, None, :], idle_slab, eps)
+    mask_l = fits_l & col_ok_l & cap_ok[safe_l] & task_ok_l[:, None]
+    return mask_l, idle_slab, safe_l
+
+
+def _slab_keys(task_req_l, task_ids_l, cand_nodes_l, cand_static_l,
+               idle_slab, safe_l, node_cap, lr_weight, br_weight,
+               mask_l):
+    """[Tl, K] masked integer bid keys (kernels._sparse_round's
+    score→key chain, GLOBAL task/node ids in the hash bits — the other
+    half of the shared parity-critical math)."""
+    dims = (CPU_DIM, MEM_DIM)
+    score_l = _dyn_score_core(
+        task_req_l[:, None, dims],
+        idle_slab[..., dims],
+        node_cap[safe_l][..., dims],
+        lr_weight, br_weight,
+    ) + cand_static_l
+    key_l = bid_keys(score_l, task_ids_l[:, None], cand_nodes_l)
+    return jnp.where(mask_l, key_l, -1)
+
+
+def _commit_on_shard0(axis, shard, bid, assigned, idle, ntask, qalloc,
+                      *, task_req, task_fit, task_rank, task_queue,
+                      node_max_tasks, queue_deserved, eps):
+    """Run `_commit_bids` on the full gathered bid vector on shard 0
+    only and psum-broadcast the packed result (zeros elsewhere) —
+    the capacity-commit collective of the sharded sparse solve."""
+    T = assigned.shape[0]
+    N, Rr = idle.shape
+    Q = qalloc.shape[0]
+
+    def do_commit(_: None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return _pack_commit(*_commit_bids(
+            bid, assigned, idle, ntask, qalloc,
+            task_req=task_req, task_fit=task_fit,
+            task_rank=task_rank, task_queue=task_queue,
+            node_max_tasks=node_max_tasks,
+            queue_deserved=queue_deserved, eps=eps,
+        ))
+
+    def skip_commit(_: None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return (
+            jnp.zeros((T + N + 1,), jnp.int32),
+            jnp.zeros((N * Rr + Q * Rr,), jnp.float32),
+        )
+
+    ibuf, fbuf = lax.psum(
+        lax.cond(shard == 0, do_commit, skip_commit, None), axis
+    )
+    return (
+        ibuf[:T],                       # assigned
+        fbuf[: N * Rr].reshape(N, Rr),  # idle
+        ibuf[T:T + N],                  # ntask
+        fbuf[N * Rr:].reshape(Q, Rr),   # qalloc
+        ibuf[T + N] > 0,                # any_accept
+    )
+
+
+def _spmd_sparse_round(
+    assigned, idle, ntask, qalloc, failed, refill,
+    *, axis, shard, t_off, n_local_tasks,
+    task_req, task_fit, task_rank, task_queue, task_valid,
+    cand_nodes_l, cand_static_l, cand_total, fits_releasing, blocked_of,
+    node_cap, node_max_tasks, queue_deserved,
+    lr_weight, br_weight, eps,
+):
+    """One sharded candidate-sparsified round. Mirrors
+    :func:`kernels._sparse_round`'s semantics exactly — same gating,
+    same complete-vs-truncated exhaustion split, same multi-commit
+    cascade — with the [T, K] work on the local row block and two
+    collectives per commit plus one exhaustion gather per round.
+    State (assigned/idle/ntask/qalloc/failed/refill) is replicated;
+    ``cand_nodes_l``/``cand_static_l`` are the shard's local slab rows.
+
+    Returns (assigned, idle, ntask, qalloc, failed, refill, any_accept).
+    """
+    T = task_req.shape[0]
+    N = idle.shape[0]
+    Tl = n_local_tasks
+    K = cand_nodes_l.shape[1]
+    arange_l = jnp.arange(Tl, dtype=jnp.int32)
+    task_ids_l = t_off + arange_l
+
+    def loc(v: jnp.ndarray) -> jnp.ndarray:
+        return lax.dynamic_slice_in_dim(v, t_off, Tl)
+
+    pending = assigned < 0
+    q_over = less_equal(queue_deserved, qalloc, eps)
+    task_ok = (
+        pending & task_valid & ~q_over[task_queue] & ~blocked_of(failed)
+        & ~refill
+    )
+
+    mask_l, idle_slab, safe_l = _slab_mask(
+        loc(task_fit), idle, ntask, node_max_tasks, cand_nodes_l,
+        cand_nodes_l < N, loc(task_ok), eps,
+    )
+
+    # Exhaustion verdicts are the round's one non-commit collective:
+    # gathered so the failed/refill/job-break state stays replicated
+    # and the job-mate re-mask below sees every shard's verdicts.
+    exhausted_l = loc(task_ok) & ~jnp.any(mask_l, axis=1)
+    exhausted = lax.all_gather(exhausted_l, axis).reshape(T)
+    failed = failed | (exhausted & (cand_total <= K) & ~fits_releasing)
+    refill = refill | (exhausted & (cand_total > K))
+    mask_l = mask_l & ~loc(blocked_of(failed) | refill)[:, None]
+
+    # GLOBAL task/node ids in the hash bits — identical keys to the
+    # single-device slab round, which is what makes the gathered bid
+    # vector (and therefore every commit) bit-equal.
+    key_l = _slab_keys(
+        loc(task_req), task_ids_l, cand_nodes_l, cand_static_l,
+        idle_slab, safe_l, node_cap, lr_weight, br_weight, mask_l,
+    )
+
+    commit_kw = dict(
+        task_req=task_req, task_fit=task_fit,
+        task_rank=task_rank, task_queue=task_queue,
+        node_max_tasks=node_max_tasks,
+        queue_deserved=queue_deserved, eps=eps,
+    )
+
+    def commit_once(_: jnp.ndarray, state: Tuple) -> Tuple:
+        assigned, idle, ntask, qalloc, any_acc, key_l = state
+        live_l = loc(assigned) < 0
+        bid_col = jnp.argmax(key_l, axis=1).astype(jnp.int32)
+        has_bid_l = live_l & (key_l[arange_l, bid_col] >= 0)
+        bid_l = jnp.where(has_bid_l, cand_nodes_l[arange_l, bid_col], N)
+        bid = lax.all_gather(bid_l, axis).reshape(T)
+        assigned, idle, ntask, qalloc, acc = _commit_on_shard0(
+            axis, shard, bid, assigned, idle, ntask, qalloc, **commit_kw
+        )
+        # Losers stop re-bidding the slab column they just lost this
+        # round — each shard voids its own rows.
+        lost_l = has_bid_l & (loc(assigned) < 0)
+        col = jnp.where(has_bid_l, bid_col, 0)
+        key_l = key_l.at[arange_l, col].set(
+            jnp.where(lost_l, -1, key_l[arange_l, col])
+        )
+        return assigned, idle, ntask, qalloc, any_acc | acc, key_l
+
+    assigned, idle, ntask, qalloc, any_accept, _ = lax.fori_loop(
+        0, COMMITS_PER_ROUND, commit_once,
+        (assigned, idle, ntask, qalloc, jnp.asarray(False), key_l),
+    )
+    return assigned, idle, ntask, qalloc, failed, refill, any_accept
+
+
+def _solve_sparse_spmd_local(
+    inputs: SolverInputs, *, axis, nshards, max_rounds, tail_bucket,
+    two_level,
+):
+    """Per-shard body of the sharded sparse solve (runs under
+    shard_map; every ``inputs`` field is a full replicated array). Task
+    axis must be divisible by ``nshards`` (sharding.pad_tasks); for
+    ``two_level`` the node axis must be too (sharding.pad_nodes)."""
+    T, R = inputs.task_req.shape
+    N = inputs.node_idle.shape[0]
+    C, K = inputs.cand_idx.shape
+    Tl = T // nshards
+    shard = lax.axis_index(axis)
+    t_off = shard * Tl
+    eps = inputs.eps
+
+    def loc(v: jnp.ndarray) -> jnp.ndarray:
+        return lax.dynamic_slice_in_dim(v, t_off, Tl)
+
+    # Class → task slab expansion, LOCAL rows only: the [T/s, K] block
+    # is the largest structure this solver ever materializes per shard.
+    cls = jnp.clip(inputs.task_cand, 0, C - 1)
+    cls_l = loc(cls)
+    cand_nodes_l = inputs.cand_idx[cls_l]                # i32[Tl, K]
+    cand_static_l = inputs.cand_static[cls_l]            # f32[Tl, K]
+    cand_total = inputs.cand_info[0][cls]                # i32[T]
+    fits_releasing = inputs.cand_info[2][cls].astype(bool)
+
+    def job_blocked(failed: jnp.ndarray) -> jnp.ndarray:
+        first_fail = jax.ops.segment_min(
+            jnp.where(failed, inputs.task_rank, INT_MAX),
+            inputs.task_job,
+            num_segments=T,
+        )
+        return inputs.task_rank > first_fail[inputs.task_job]
+
+    shared_kw = dict(
+        node_cap=inputs.node_cap, node_max_tasks=inputs.node_max_tasks,
+        queue_deserved=inputs.queue_deserved,
+        lr_weight=inputs.lr_weight, br_weight=inputs.br_weight, eps=eps,
+    )
+    round_kw = dict(
+        axis=axis, shard=shard, t_off=t_off, n_local_tasks=Tl,
+        task_req=inputs.task_req, task_fit=inputs.task_fit,
+        task_rank=inputs.task_rank, task_queue=inputs.task_queue,
+        task_valid=inputs.task_valid,
+        cand_nodes_l=cand_nodes_l, cand_static_l=cand_static_l,
+        cand_total=cand_total,
+        fits_releasing=fits_releasing, blocked_of=job_blocked,
+        **shared_kw,
+    )
+
+    assigned = jnp.full((T,), -1, jnp.int32)
+    idle = inputs.node_idle
+    ntask = inputs.node_task_count
+    qalloc = inputs.queue_allocated
+    local_rounds = jnp.array(0, jnp.int32)
+
+    if two_level:
+        # ---- level 1: collective-free per-rack solve ------------------
+        # Rack i owns node rows [i·N/s, (i+1)·N/s) and a 1/s slice of
+        # every queue's remaining headroom; shard i places its own task
+        # block on its rack's candidate columns only. Disjoint node
+        # ownership + sliced budgets make the psum reconcile below
+        # exact; anything unplaced spills to the global drain.
+        Nl = N // nshards
+        rack_lo = shard * Nl
+        rack_hi = rack_lo + Nl
+        headroom = inputs.queue_deserved - inputs.queue_allocated
+        deserved_l = jnp.where(
+            jnp.isinf(inputs.queue_deserved),
+            inputs.queue_deserved,
+            inputs.queue_allocated + headroom / nshards,
+        )
+        arange_l = jnp.arange(Tl, dtype=jnp.int32)
+        task_ids_l = t_off + arange_l
+        req_l = loc(inputs.task_req)
+        fit_l = loc(inputs.task_fit)
+        rank_l = loc(inputs.task_rank)
+        queue_l = loc(inputs.task_queue)
+        valid_task_l = loc(inputs.task_valid)
+        in_rack = (cand_nodes_l >= rack_lo) & (cand_nodes_l < rack_hi)
+
+        local_commit_kw = dict(
+            task_req=req_l, task_fit=fit_l,
+            task_rank=rank_l, task_queue=queue_l,
+            node_max_tasks=inputs.node_max_tasks,
+            queue_deserved=deserved_l, eps=eps,
+        )
+
+        def local_round(state: Tuple) -> Tuple:
+            assigned_l, idle, ntask, qalloc, spill_l, _, rnd = state
+            pending_l = assigned_l < 0
+            q_over = less_equal(deserved_l, qalloc, eps)
+            task_ok_l = (
+                pending_l & valid_task_l & ~q_over[queue_l] & ~spill_l
+            )
+            mask_l, idle_slab, safe_l = _slab_mask(
+                fit_l, idle, ntask, inputs.node_max_tasks,
+                cand_nodes_l, in_rack, task_ok_l, eps,
+            )
+            # A rack-local exhaustion is a SPILL, never a job break:
+            # the global drain holds the complete-slab evidence.
+            spill_l = spill_l | (task_ok_l & ~jnp.any(mask_l, axis=1))
+            key_l = _slab_keys(
+                req_l, task_ids_l, cand_nodes_l, cand_static_l,
+                idle_slab, safe_l, inputs.node_cap,
+                inputs.lr_weight, inputs.br_weight, mask_l,
+            )
+
+            def commit_once(_: jnp.ndarray, cstate: Tuple) -> Tuple:
+                assigned_l, idle, ntask, qalloc, any_acc, key_l = cstate
+                live_l = assigned_l < 0
+                bid_col = jnp.argmax(key_l, axis=1).astype(jnp.int32)
+                has_bid = live_l & (key_l[arange_l, bid_col] >= 0)
+                bid_l = jnp.where(
+                    has_bid, cand_nodes_l[arange_l, bid_col], N
+                )
+                assigned_l, idle, ntask, qalloc, acc = _commit_bids(
+                    bid_l, assigned_l, idle, ntask, qalloc,
+                    **local_commit_kw,
+                )
+                lost = has_bid & (assigned_l < 0)
+                col = jnp.where(has_bid, bid_col, 0)
+                key_l = key_l.at[arange_l, col].set(
+                    jnp.where(lost, -1, key_l[arange_l, col])
+                )
+                return assigned_l, idle, ntask, qalloc, any_acc | acc, key_l
+
+            assigned_l, idle, ntask, qalloc, any_acc, _ = lax.fori_loop(
+                0, COMMITS_PER_ROUND, commit_once,
+                (
+                    assigned_l, idle, ntask, qalloc, jnp.asarray(False),
+                    key_l,
+                ),
+            )
+            return (
+                assigned_l, idle, ntask, qalloc, spill_l, any_acc,
+                rnd + 1,
+            )
+
+        def local_cond(state: Tuple) -> jnp.ndarray:
+            return state[5] & (state[6] < max_rounds)
+
+        (
+            assigned_l, idle_L, ntask_L, qalloc_L, _, _, lrnd
+        ) = lax.while_loop(
+            local_cond, local_round,
+            (
+                jnp.full((Tl,), -1, jnp.int32), idle, ntask, qalloc,
+                jnp.zeros((Tl,), bool), jnp.array(True),
+                jnp.array(0, jnp.int32),
+            ),
+        )
+
+        # ---- reconcile: exact psum merge of the disjoint deltas -------
+        assigned = lax.all_gather(assigned_l, axis).reshape(T)
+        idle = idle + lax.psum(idle_L - idle, axis)
+        ntask = ntask + lax.psum(ntask_L - ntask, axis)
+        qalloc = qalloc + lax.psum(qalloc_L - qalloc, axis)
+        local_rounds = lax.pmax(lrnd, axis)
+
+    # ---- flat sharded rounds to a fixed point -------------------------
+    # (two-level enters here as the global reconciliation drain: spilled
+    # tasks re-bid their FULL slabs against the merged state.)
+    def body(state: Tuple) -> Tuple:
+        assigned, idle, ntask, qalloc, failed, refill, _, rnd = state
+        (
+            assigned, idle, ntask, qalloc, failed, refill, any_accept
+        ) = _spmd_sparse_round(
+            assigned, idle, ntask, qalloc, failed, refill, **round_kw
+        )
+        return (
+            assigned, idle, ntask, qalloc, failed, refill, any_accept,
+            rnd + 1,
+        )
+
+    def cond(state: Tuple) -> jnp.ndarray:
+        return state[6] & (state[7] < max_rounds)
+
+    (
+        assigned, idle, ntask, qalloc, failed, refill, _, grounds
+    ) = lax.while_loop(
+        cond, body,
+        (
+            assigned, idle, ntask, qalloc,
+            jnp.zeros((T,), bool), jnp.zeros((T,), bool),
+            jnp.array(True), jnp.array(0, jnp.int32),
+        ),
+    )
+    refills = jnp.sum(refill.astype(jnp.int32))
+    rounds = local_rounds + grounds
+
+    # ---- refill / drain: the SHARED compacted dense stage -------------
+    # Same `_dense_tail` the single-device sparse solve drains through,
+    # on the replicated full inputs — run on shard 0 and broadcast
+    # (same rationale as the commit: replicated tail compute is free on
+    # parallel chips, s× wall time on an emulated mesh).
+    Q = qalloc.shape[0]
+
+    def do_tail(_: None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        (
+            a, i, _nt, q, _f, rr, st
+        ) = _dense_tail(
+            inputs, assigned, idle, ntask, qalloc, failed, rounds,
+            fits_releasing=fits_releasing, job_blocked=job_blocked,
+            shared_kw=shared_kw, max_rounds=max_rounds,
+            tail_bucket=tail_bucket,
+        )
+        return (
+            jnp.concatenate([a, jnp.stack([rr, st])]),
+            jnp.concatenate([i.ravel(), q.ravel()]),
+        )
+
+    def skip_tail(_: None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return (
+            jnp.zeros((T + 2,), jnp.int32),
+            jnp.zeros((N * R + Q * R,), jnp.float32),
+        )
+
+    ibuf, fbuf = lax.psum(
+        lax.cond(shard == 0, do_tail, skip_tail, None), axis
+    )
+    assigned = ibuf[:T]
+    rounds = ibuf[T]
+    stages = ibuf[T + 1]
+    idle = fbuf[: N * R].reshape(N, R)
+    qalloc = fbuf[N * R:].reshape(Q, R)
+    return SolverResult(
+        assigned, idle, qalloc, rounds, stages, refills,
+        reconcile_rounds=grounds,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _spmd_sparse_step(mesh: Mesh, max_rounds, tail_bucket, two_level):
+    """Jitted shard_map SPARSE solve for a mesh (cached per config;
+    weakref-registered in the retrace census like every sharded
+    step)."""
+    axis = mesh.axis_names[0]
+    nshards = mesh.size
+
+    def run(inputs: Any) -> SolverResult:
+        if isinstance(inputs, PackedInputs):
+            inputs = inputs.unpack()  # inside jit: free slicing
+        in_specs = SolverInputs(**{
+            f: (None if getattr(inputs, f, None) is None else P())
+            for f in SolverInputs._fields
+        })
+        fn = shard_map(
+            functools.partial(
+                _solve_sparse_spmd_local,
+                axis=axis,
+                nshards=nshards,
+                max_rounds=max_rounds,
+                tail_bucket=tail_bucket,
+                two_level=two_level,
+            ),
+            mesh=mesh,
+            in_specs=(in_specs,),
+            out_specs=P(),
+            # Outputs are replicated by construction (every carry is
+            # either gathered or psum-broadcast); the static checker
+            # cannot see through the while_loop carries.
+            check_rep=False,
+        )
+        return fn(inputs)
+
+    import weakref
+
+    step = jax.jit(run)
+    _jitted_steps.append(weakref.ref(step))
+    return step
+
+
+def solve_sparse_spmd(
+    inputs: Any,
+    mesh: Mesh,
+    max_rounds: int = 256,
+    tail_bucket: int = 3072,
+    two_level: bool = False,
+) -> SolverResult:
+    """Run the candidate-sparsified solve with slab rows sharded over
+    ``mesh``. Flat mode (default) is bit-equal to the single-device
+    :func:`kernels.solve_sparse`; ``two_level`` runs the Tesserae-style
+    per-rack solve + global reconciliation (quality-approximate,
+    invariant-exact). Task axis must be divisible by ``mesh.size``
+    (sharding.pad_tasks), and the node axis too for ``two_level``."""
+    return _spmd_sparse_step(
+        mesh, max_rounds, tail_bucket, bool(two_level)
+    )(inputs)

@@ -50,6 +50,12 @@ fallback (full solve, labeled by reason)
       are carried. The subset problem would order/tie-break differently
       than the full problem (progressive-filling keys and bid-key
       hashes are rank-dependent), so bit-parity forces the full solve;
+    - ``mesh-changed``: the solver's device layout token moved since
+      the save (KBT_SPARSE_SHARD_MODE flip — the device set itself is
+      process-constant): the flat sharded mode is bit-parity but the
+      two-level mode is not, so carried verdicts conservatively void
+      whenever the layout a solve would run under differs from the one
+      that produced them;
     - ``drift``: the warm-noop tensorize found node rows dirty beyond
       the narrow ledger (a session-side mutation the plan could not
       see) — the cycle re-runs as a full solve.
@@ -77,11 +83,16 @@ class WarmSolveState:
 
     __slots__ = (
         "valid", "snap_gen", "carried", "queue_deserved", "has_releasing",
+        "mesh_token",
     )
 
     def __init__(self):
         self.valid = False
         self.snap_gen = -1
+        # Solver device-layout token at save time
+        # (sharding.prospective_layout_token); None until a sharded
+        # dispatch has pinned the device count.
+        self.mesh_token = None
         # job uid -> (job clone object, clone _ver at save, pending
         # remainder at save). Identity+ver pins "untouched"; a
         # narrow-dirty re-clone passes iff its pending count still
@@ -109,6 +120,16 @@ def warm_state_of(cache) -> Optional[WarmSolveState]:
 
 def warm_enabled() -> bool:
     return os.environ.get("KBT_WARM", "1") != "0"
+
+
+def _layout_token():
+    """The solver device-layout token a solve dispatched now would run
+    under (None before any sharded dispatch — see
+    sharding.prospective_layout_token; never probes the backend, so
+    the native-route and pre-init paths stay hang-safe)."""
+    from . import sharding
+
+    return sharding.prospective_layout_token()
 
 
 def _res_eq(a, b) -> bool:
@@ -141,6 +162,16 @@ def plan_warm(ssn) -> Tuple[str, List]:
         return "cold", []
     if getattr(ssn, "snap_gen", 0) != ws.snap_gen + 1:
         return "stale", []
+    cur_token = _layout_token()
+    if (
+        cur_token is not None
+        and ws.mesh_token is not None
+        and cur_token != ws.mesh_token
+    ):
+        # The solver's device layout moved under the carried verdicts
+        # (mode flip; device count is process-constant): conservatively
+        # re-solve — the two-level mode is not bit-parity.
+        return "mesh-changed", []
     if ssn.dirty_nodes:
         return "node-dirty", []
     if ws.has_releasing:
@@ -218,6 +249,7 @@ def advance_noop(ssn) -> None:
     if ws is None:
         return
     ws.snap_gen = getattr(ssn, "snap_gen", 0)
+    ws.mesh_token = _layout_token()
     for uid, (obj, ver, remainder) in list(ws.carried.items()):
         job = ssn.jobs.get(uid)
         if job is not None and (job is not obj or job._ver != ver):
@@ -277,5 +309,6 @@ def save_warm_state(ssn, ctx, assigned) -> int:
     ws.queue_deserved = deserved
     ws.has_releasing = has_releasing
     ws.snap_gen = getattr(ssn, "snap_gen", 0)
+    ws.mesh_token = _layout_token()
     ws.valid = True
     return len(carried)

@@ -14,9 +14,14 @@ scores or conflicts:
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
 
-import jax.numpy as jnp
+# Optional dependency: some images ship without hypothesis — the module
+# must SKIP cleanly, not fail tier-1 collection.
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
 
 from kube_batch_tpu.solver import make_inputs, solve_jit
 

@@ -14,11 +14,17 @@ jits) across churning cycles that stay inside their buckets.
 """
 
 import numpy as np
+import pytest
 
 import kube_batch_tpu.actions  # noqa: F401 (registers actions)
 import kube_batch_tpu.plugins  # noqa: F401 (registers plugins)
 from kube_batch_tpu.framework import close_session, open_session
-from kube_batch_tpu.solver import jit_compilation_count, solve_jit, tensorize
+from kube_batch_tpu.solver import (
+    jit_compilation_count,
+    solve_jit,
+    solve_sharded,
+    tensorize,
+)
 
 from tests.actions.test_actions import DEFAULT_TIERS_ARGS, make_tiers
 from tests.unit.test_cycle_pipeline import build_cluster
@@ -28,15 +34,16 @@ WARM_CYCLES = 3   # cold pack + first patch buckets + solve compile
 GUARD_CYCLES = 6  # steady/delta cycles that must stay trace-free
 
 
-def one_cycle(cache, tiers, churn):
+def one_cycle(cache, tiers, churn, solver=None):
     """One tensorize → solve → apply-some cycle; churn keeps every axis
     inside its shape bucket (fixed task count per step, fixed node
     fan-out) so no re-jit is legitimate."""
+    solver = solver or solve_jit
     ssn = open_session(cache, tiers)
     inputs, ctx = tensorize(ssn)
     placed = 0
     if inputs is not None:
-        result = solve_jit(inputs)
+        result = solver(inputs)
         assigned = np.asarray(result.assigned)
         # Apply a FIXED-SIZE slice of the assignment through the
         # session so the mirror churns by the same amount every cycle.
@@ -66,5 +73,38 @@ def test_zero_new_compilations_across_steady_delta_cycles():
         assert now == warm, (
             f"cycle {cycle} minted {now - warm} new jit compilation(s) "
             "— a shape/dtype drift reintroduced per-cycle tracing"
+        )
+    c.shutdown()
+
+
+def test_zero_new_compilations_sharded_sparse_cycles(monkeypatch):
+    """The sharded-sparse twin: steady/delta cycles through the
+    task-sharded shard_map sparse solve (forced slabs + flat mode on
+    the 8-device mesh) must compile a bounded step set during warmup
+    and then go flat — the sharded step AND the replicated-placement
+    patch jits are all in the `jit_compilation_count` census
+    (spmd._jitted_steps weakrefs + patch_jit_cache_size)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device virtual CPU mesh")
+    monkeypatch.setenv("KBT_SOLVER_TOPK", "8")
+    monkeypatch.setenv("KBT_SPARSE_SHARD_MODE", "flat")
+    from kube_batch_tpu.solver import sharding as sharding_mod
+
+    c = build_cluster(seed=47, groups=6, per_group=40, nodes=8)
+    tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+    for _ in range(WARM_CYCLES):
+        one_cycle(c, tiers, churn=2, solver=solve_sharded)
+    assert sharding_mod.last_dispatch.get("mode") == "flat"
+    warm = jit_compilation_count()
+    assert warm > 0
+    for cycle in range(GUARD_CYCLES):
+        one_cycle(c, tiers, churn=2, solver=solve_sharded)
+        now = jit_compilation_count()
+        assert now == warm, (
+            f"sharded sparse cycle {cycle} minted {now - warm} new jit "
+            "compilation(s) — a shape/dtype/layout drift reintroduced "
+            "per-cycle tracing on the sharded path"
         )
     c.shutdown()

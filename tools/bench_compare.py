@@ -82,6 +82,19 @@ POLICY: List[Tuple[str, str, float, str]] = [
     ("obs.telemetry_overhead_pct", "lower", 10.0, "ratio"),
     ("sim.invariant_check_ms_per_cycle", "lower", 0.50, "med"),
     ("sparse_scale.solve_ms", "lower", 0.35, "single"),
+    # 1M x 100k headline point (PR 12): single-shot select+solve on a
+    # loaded shared host — generous thresholds, but completion (placed)
+    # must never drop.
+    ("sparse_scale_xl.select_ms", "lower", 0.50, "single"),
+    ("sparse_scale_xl.solve_ms", "lower", 0.50, "single"),
+    ("sparse_scale_xl.placed", "count", 0.0, "exact"),
+    # Sharded-vs-single sparse A/B (4 forced host devices, subprocess):
+    # parity is the contract (flat bit-equal to single); timings track
+    # the collective-overhead trend only.
+    ("sharded_vs_single.parity", "count", 0.0, "exact"),
+    ("sharded_vs_single.single_ms", "lower", 0.50, "single"),
+    ("sharded_vs_single.flat_ms", "lower", 0.50, "single"),
+    ("sharded_vs_single.two_level_ms", "lower", 0.50, "single"),
     ("vs_baseline", "higher", 0.25, "ratio"),
     ("pods_placed_per_sec", "higher", 0.25, "min3"),
     ("sim.cycles_per_sec", "higher", 0.35, "med"),

@@ -50,6 +50,11 @@ TABLE_NAMES = {
     "PackedInputs": "PACKED_INPUT_CONTRACTS",
 }
 
+# Sharded-solve partition tables (field -> mesh-sharded dim index, or
+# replicated when absent): every key must name a declared SolverInputs
+# field and every dim must exist in its declared rank.
+SHARD_DIM_TABLE_NAMES = ("DENSE_SPMD_SHARD_DIMS", "SPARSE_SHARD_DIMS")
+
 _COMMENT_RE = re.compile(
     r"#\s*(?:(f32|f64|i32|i64|bool)\s*)?\[([^\]]*)\]"
 )
@@ -93,6 +98,50 @@ def load_tables(project: Project) -> Tuple[
                 pf.rel, line,
             )
     return None, None, "", 0
+
+
+def shard_dim_findings(
+    project: Project, solver_table: Dict[str, dict],
+) -> List[Finding]:
+    """Check every *_SHARD_DIMS table: keys must be declared
+    SolverInputs fields, dims must index into the declared rank."""
+    findings: List[Finding] = []
+    for pf in project.files:
+        for node in ast.walk(pf.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in SHARD_DIM_TABLE_NAMES
+            ):
+                continue
+            tname = node.targets[0].id
+            try:
+                table = ast.literal_eval(node.value)
+            except ValueError:
+                findings.append(Finding(
+                    PASS_ID, pf.rel, node.lineno,
+                    f"{tname} is not a pure literal dict — the shard "
+                    f"layout declaration must stay AST-parseable",
+                ))
+                continue
+            for field, dim in sorted(table.items()):
+                contract = solver_table.get(field)
+                if contract is None:
+                    findings.append(Finding(
+                        PASS_ID, pf.rel, node.lineno,
+                        f"{tname} shards {field!r} but SolverInputs "
+                        f"declares no such field",
+                    ))
+                    continue
+                rank = len(contract["shape"])
+                if not isinstance(dim, int) or not 0 <= dim < rank:
+                    findings.append(Finding(
+                        PASS_ID, pf.rel, node.lineno,
+                        f"{tname}[{field!r}] shards dim {dim!r} but the "
+                        f"contract declares rank {rank}",
+                    ))
+    return findings
 
 
 def _named_tuple_fields(pf: ProjectFile, cls_name: str):
@@ -355,6 +404,9 @@ def run(project: Project) -> List[Finding]:
             findings.extend(comment_contract_findings(
                 cls_name, fields, table, pf.rel,
             ))
+
+    if solver_table is not None:
+        findings.extend(shard_dim_findings(project, solver_table))
 
     if packed_table is not None:
         row_axis, ra_rel, ra_line = _find_row_axis(project)
