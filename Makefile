@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke bench-compare verify kbtlint typecheck ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke bench-compare verify kbtlint typecheck ci image clean
 
 all: native
 
@@ -127,6 +127,28 @@ shard-smoke:
 		--backend sparse --topk 8 \
 		--require-sparse-sharded --fail-on-cycle-errors --quiet
 
+# Failover kill drill: the leader is hard-stopped at EVERY seeded cut
+# point (pre-solve / post-solve-pre-drain / mid-bind-drain / mid-close,
+# sim/failover.py) with bind faults layered on top; each successor
+# takes the lease, replays the bind-intent journal against cluster
+# truth (cache/recovery.py) and repairs any partial gang. Exit 1 on any
+# invariant violation across a failover boundary, 3 on cycle errors,
+# 6 if a required cut never fired or a recovery reported errors — then
+# the recorded trace is REPLAYED and must match byte-for-byte
+# (placements AND the failover/recovery blocks), exit 2 otherwise.
+# doc/design/robustness.md (failover section); the committed
+# FAILOVER_r13.json is one full drill's report.
+failover-smoke:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim \
+		--cycles 60 --seed 13 --backend native --arrival-rate 3 \
+		--faults "bind:0.03" \
+		--kill-at "8:pre-solve,20:post-solve-pre-drain,32:mid-bind-drain,44:mid-close" \
+		--trace /tmp/kbt_failover_smoke.jsonl \
+		--require-kill-cuts all --fail-on-cycle-errors --quiet
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim \
+		--replay /tmp/kbt_failover_smoke.jsonl --backend native \
+		--require-kill-cuts all --fail-on-cycle-errors --quiet
+
 # Bench regression sentinel across the two newest committed bench
 # rounds (noise-aware: canary-normalized thresholds + the explicit
 # allowlist), THEN its own self-test: an injected 20% cycle_ms
@@ -181,7 +203,7 @@ typecheck:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke bench-compare
+ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

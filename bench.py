@@ -1057,6 +1057,136 @@ def bench_sim(cycles=80, seed=11):
     return out
 
 
+def bench_recovery(cfg="large", seed=0):
+    """Cold-takeover failover recovery at the benched shape
+    (doc/design/robustness.md, failover section): a predecessor died
+    mid-bind-drain leaving a populated cluster + a bind-intent journal
+    with every classification class represented; measure what a
+    successor pays before it can schedule — fresh-cache ingest of the
+    whole cluster, the journal scan + reconcile (incl. gang re-drives
+    and one eviction), and its first post-recovery scheduling cycle."""
+    from kube_batch_tpu.api.objects import DEFAULT_SCHEDULER_NAME
+    from kube_batch_tpu.cache.recovery import reconcile_journal
+    from kube_batch_tpu.cluster import InProcessCluster
+
+    n_tasks, n_nodes, n_queues, n_groups = CONFIGS[cfg]
+    rng = np.random.RandomState(seed)
+    cluster = InProcessCluster(simulate_kubelet=True)
+    for q in range(n_queues):
+        cluster.create_queue(build_queue(f"q{q}", weight=q + 1))
+    for j in range(n_nodes):
+        cluster.create_node(build_node(
+            f"n{j}", build_resource_list(cpu="32", memory="128Gi", pods=110)
+        ))
+    per_group = n_tasks // n_groups
+    # ~1/16 of the gangs were mid-dispatch at the crash; the rest are
+    # the predecessor's steady-state placements (bound + Running).
+    inflight_from = n_groups - max(2, n_groups // 16)
+    cpus = rng.choice([250, 500, 1000, 2000], size=n_tasks)
+    mems = rng.choice([256, 512, 1024, 4096], size=n_tasks)
+    t = 0
+    journaled = 0
+    intents = []
+    for g in range(n_groups):
+        inflight = g >= inflight_from
+        # The last in-flight gang targets a node that died with the
+        # leader — unrepairable, recovery must evict its partial
+        # placement (the all-or-nothing arm).
+        node_gone = inflight and g == n_groups - 1
+        cluster.create_pod_group(build_pod_group(
+            f"pg{g}", namespace="bench",
+            min_member=per_group if inflight else int(
+                rng.randint(1, per_group + 1)
+            ),
+            queue=f"q{g % n_queues}",
+        ))
+        tasks = []
+        for i in range(per_group):
+            target = f"n{t % n_nodes}"
+            pod = build_pod(
+                "bench", f"pg{g}-p{i}", "",
+                PodPhase.PENDING,
+                build_resource_list(
+                    cpu=f"{int(cpus[t])}m", memory=f"{int(mems[t])}Mi"
+                ),
+                group_name=f"pg{g}",
+            )
+            cluster.create_pod(pod)
+            if not inflight:
+                cluster.bind_pod(pod, target)
+            else:
+                lot = i % 5
+                if node_gone:
+                    # Half bound (to evict), half lost to a dead node.
+                    if lot < 2:
+                        cluster.bind_pod(pod, target)
+                    else:
+                        target = "nGONE"
+                elif lot < 2:
+                    cluster.bind_pod(pod, target)  # applied, marked
+                elif lot == 2:
+                    cluster.bind_pod(pod, target)  # applied, mark lost
+                # lot > 2: lost — recovery re-drives to complete
+                tasks.append({
+                    "uid": pod.uid, "pod": f"bench/{pod.name}",
+                    "node": target, "job": f"bench/pg{g}",
+                    "mark": "applied" if lot < 2 else None,
+                })
+            t += 1
+        if tasks:
+            journaled += len(tasks)
+            seq = cluster.append_bind_intent({
+                "leader": "bench-dead-leader",
+                "tasks": [
+                    {k: v for k, v in task.items() if k != "mark"}
+                    for task in tasks
+                ],
+                "gangs": {f"bench/pg{g}": per_group},
+            })
+            intents.append(seq)
+            for task in tasks:
+                if task["mark"]:
+                    cluster.mark_bind_intent(seq, task["uid"], task["mark"])
+
+    # The successor: fresh cache, full ingest, reconcile, first cycle.
+    t0 = time.perf_counter()
+    cache = SchedulerCache(
+        cluster=cluster, scheduler_name=DEFAULT_SCHEDULER_NAME,
+        default_queue="q0",
+    )
+    cache.start_ingest()
+    ingest_s = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    report = reconcile_journal(cluster, "bench-successor")
+    reconcile_s = time.perf_counter() - t1
+    cache.wait_for_side_effects()
+
+    t2 = time.perf_counter()
+    ssn = open_session(cache, make_tiers(*TIERS_ARGS))
+    action, _ = get_action("allocate_tpu")
+    action.execute(ssn)
+    close_session(ssn)
+    first_cycle_s = time.perf_counter() - t2
+    cache.wait_for_side_effects()
+    cache.shutdown()
+    return {
+        "shape": f"{n_tasks}x{n_nodes}",
+        "intents": len(intents),
+        "tasks_journaled": journaled,
+        "ingest_ms": round(ingest_s * 1e3, 1),
+        "reconcile_ms": round(reconcile_s * 1e3, 1),
+        "first_cycle_ms": round(first_cycle_s * 1e3, 1),
+        "takeover_ms": round(
+            (ingest_s + reconcile_s + first_cycle_s) * 1e3, 1
+        ),
+        "outcomes": dict(sorted(report.outcomes.items())),
+        "gangs_repaired": len(report.gangs_repaired),
+        "gangs_evicted": len(report.gangs_evicted),
+        "recovery_errors": report.errors,
+    }
+
+
 def run_smoke():
     """``bench.py --smoke`` (the `make bench-smoke` target): small
     shapes through the full production cycle with the sparse solver
@@ -1318,6 +1448,13 @@ def main():
     except Exception as exc:  # pragma: no cover - defensive
         sim = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # Cold-takeover failover recovery at the headline shape (journal
+    # scan + reconcile + first post-recovery cycle); guarded.
+    try:
+        recovery = bench_recovery(headline_cfg)
+    except Exception as exc:  # pragma: no cover - defensive
+        recovery = {"error": f"{type(exc).__name__}: {exc}"}
+
     dev0 = jax.devices()[0]
     provenance = {
         "platform": str(dev0.platform),
@@ -1346,6 +1483,7 @@ def main():
         "device_cache": device_cache,
         "solver_sparse": tpu["sparse"],
         "sim": sim,
+        "recovery": recovery,
         **({"sparse_scale": sparse_scale} if sparse_scale else {}),
         **({"sparse_scale_xl": sparse_scale_xl} if sparse_scale_xl
            else {}),

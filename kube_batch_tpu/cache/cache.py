@@ -255,6 +255,21 @@ class SchedulerCache(Cache, EventHandlersMixin):
         # pod of ours lands in the mirror.
         self._arrival_listener = None
 
+        # Bind-intent journal (doc/design/robustness.md, failover):
+        # at commit-dispatch time every bind batch appends a durable
+        # intent record to the cluster's journal seam BEFORE any side
+        # effect is issued, and each task is marked applied/failed as
+        # its bind drains — so a successor leader can classify every
+        # in-flight bind after a crash. KBT_BIND_JOURNAL=0 disables.
+        self.journal_enabled = (
+            getattr(cluster, "supports_bind_journal", False)
+            and os.environ.get("KBT_BIND_JOURNAL", "1") != "0"
+        )
+        # Identity stamped into journal records (the elector identity in
+        # server mode, the sim instance id in drills): recovery
+        # distinguishes a predecessor's intents from its own.
+        self.leader_identity = f"{scheduler_name}-{os.getpid()}"
+
         self._executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="cache-sideeffect"
         )
@@ -963,6 +978,83 @@ class SchedulerCache(Cache, EventHandlersMixin):
             except Exception:  # pragma: no cover - listener is advisory
                 logger.exception("arrival listener failed")
 
+    # -- bind-intent journal --------------------------------------------------
+
+    def _journal_append(self, task_infos) -> Optional[int]:
+        """Append one intent record covering ``task_infos`` (each with
+        node_name set) to the cluster journal; returns the seq, or None
+        when journaling is off or the append failed. A failed append is
+        LOGGED and the binds proceed — availability beats perfect
+        recoverability; the resync path still covers the tasks."""
+        if not self.journal_enabled or not task_infos:
+            return None
+        tasks = []
+        gang_jobs = set()
+        for ti in task_infos:
+            tasks.append({
+                "uid": ti.uid,
+                "pod": f"{ti.namespace}/{ti.name}",
+                "node": ti.node_name,
+                "job": ti.job,
+            })
+            gang_jobs.add(ti.job)
+        gangs = {}
+        with self.mutex:
+            for job_key in sorted(gang_jobs):
+                job = self.jobs.get(job_key)
+                if job is not None and job.min_available > 1:
+                    gangs[job_key] = job.min_available
+        record = {
+            "leader": self.leader_identity,
+            "tasks": tasks,
+            "gangs": gangs,
+        }
+        try:
+            seq = self.cluster.append_bind_intent(record)
+        except Exception:
+            logger.exception(
+                "bind-intent journal append failed for %d task(s); "
+                "binds proceed unjournaled", len(tasks),
+            )
+            return None
+        try:
+            from .. import metrics
+
+            metrics.register_journal_event("appended")
+        except Exception:  # pragma: no cover - metrics must never kill
+            logger.exception("journal metric update failed")
+        return seq
+
+    def _journal_mark(self, seq: Optional[int], task_uid: str,
+                      outcome: str) -> None:
+        """Mark one task's intent outcome (applied/failed); best-effort
+        — an unmarked intent classifies via cluster truth at recovery."""
+        if seq is not None:
+            self._journal_mark_many(seq, {task_uid: outcome})
+
+    def _journal_mark_many(self, seq: Optional[int], marks) -> None:
+        """Batched mark flush for one drained bind chunk: ONE journal
+        round trip (on a real cluster, one Lease CAS) instead of one
+        per task. Best-effort like the single form."""
+        if seq is None or not marks:
+            return
+        try:
+            resolved = self.cluster.mark_bind_intents(seq, marks)
+        except Exception:
+            logger.exception(
+                "bind-intent mark flush failed for %d task(s)", len(marks)
+            )
+            return
+        try:
+            from .. import metrics
+
+            for outcome in sorted(marks.values()):
+                metrics.register_journal_event(outcome)
+            if resolved:
+                metrics.register_journal_event("resolved")
+        except Exception:  # pragma: no cover - metrics must never kill
+            logger.exception("journal metric update failed")
+
     # -- side effects --------------------------------------------------------
 
     def _find_job_and_task(self, ti: TaskInfo):
@@ -1012,20 +1104,33 @@ class SchedulerCache(Cache, EventHandlersMixin):
             node.add_task(task)
         return job, task, prior
 
-    def _bind_side_effect(self, pod, hostname, task_snapshot) -> None:
+    def _bind_side_effect(self, pod, hostname, task_snapshot,
+                          journal_seq: Optional[int] = None,
+                          mark_sink=None) -> None:
         """Async half of bind. The volume bind wait (up to the reference's
         30s, cache.go:260-268) runs HERE on the side-effect pool, not in
         the scheduling loop — one slow volume must not stall every other
         job's cycle. A timeout/failure releases the claim assumptions and
-        resyncs the task without binding the pod."""
+        resyncs the task without binding the pod.
+
+        ``mark_sink``: chunked callers pass a dict collecting this
+        task's journal outcome; the chunk flushes them in ONE journal
+        round trip (_journal_mark_many) instead of one per task."""
         if self._refused_by_fence(
             f"bind side effect {pod.namespace}/{pod.name} -> {hostname}"
         ):
-            # No resync either: the task is the NEW leader's to place.
+            # No resync either: the task is the NEW leader's to place —
+            # and no journal mark: the intent stays open for the
+            # successor's recovery pass to classify against cluster
+            # truth (a fenced leader cannot know what landed).
             return
         try:
             self.volume_binder.bind_volumes(task_snapshot)
             self.binder.bind(pod, hostname)
+            if mark_sink is not None:
+                mark_sink[task_snapshot.uid] = "applied"
+            else:
+                self._journal_mark(journal_seq, task_snapshot.uid, "applied")
             if self.cluster is not None:
                 self.cluster.record_event(
                     pod, "Normal", "Scheduled",
@@ -1039,6 +1144,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 logger.exception(
                     "failed to release volumes of %s", task_snapshot.uid
                 )
+            if mark_sink is not None:
+                mark_sink[task_snapshot.uid] = "failed"
+            else:
+                self._journal_mark(journal_seq, task_snapshot.uid, "failed")
             self._resync_task(task_snapshot)
 
     def bind(self, task_info: TaskInfo, hostname: str) -> None:
@@ -1052,9 +1161,17 @@ class SchedulerCache(Cache, EventHandlersMixin):
             pod, task_snapshot = task.pod, task.clone()
 
         if self.binder is not None:
-            self._submit_side_effect(
-                lambda: self._bind_side_effect(pod, hostname, task_snapshot)
-            )
+            def _single_bind():
+                # Journal on the worker, not the dispatching cycle (on
+                # a real cluster an append is a blocking Lease CAS, and
+                # per-task dispatch paths call bind() in a loop); the
+                # append still strictly precedes the bind in this job.
+                seq = self._journal_append([task_snapshot])
+                self._bind_side_effect(
+                    pod, hostname, task_snapshot, journal_seq=seq
+                )
+
+            self._submit_side_effect(_single_bind)
 
     # Batched side-effect jobs are chunked so (a) a 50k-task gang doesn't
     # monopolize one of the pool's workers for its whole serial run and
@@ -1105,9 +1222,24 @@ class SchedulerCache(Cache, EventHandlersMixin):
         block up to the volume-bind timeout, and a slow volume must not
         head-of-line-block the rest of the gang. Each task_info must have
         node_name set. Returns the tasks whose bookkeeping succeeded."""
+        # Journal the batch's intent FIRST — on this worker, not the
+        # scheduling loop (on a real cluster an append is a blocking
+        # HTTP CAS with retries; the cycle must not pay it). The
+        # journal-before-any-side-effect ordering is preserved: every
+        # bind of this batch is submitted from THIS job, below, and a
+        # crash before this point leaves no cluster write to classify.
+        journal_seq = self._journal_append(task_infos)
         binds = []
         slow_binds = []  # volume wait possible: isolate per task
         bound = []
+        # Journal marks for tasks that terminally fail DURING the
+        # under-mutex staging (validation failure, node revert). The
+        # marks are issued AFTER the mutex is released: on a real
+        # cluster a mark is an HTTP CAS, and blocking network I/O under
+        # cache.mutex is exactly the class kbtlint's lock-order pass
+        # forbids (it would stall snapshot/ingest and could trip the
+        # watchdog on a slow API server).
+        failed_marks: list = []
         with self.mutex:
             # hostname -> [(ti, stored, prior status/node for revert)]
             staged: Dict[str, list] = {}
@@ -1126,6 +1258,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     logger.exception(
                         "failed to bind task %s/%s", ti.namespace, ti.name
                     )
+                    # Resolve the intent (post-mutex): this task's bind
+                    # will never be issued, so an open mark would pin
+                    # the record in the journal for the leader's life.
+                    failed_marks.append(ti.uid)
             # Status-index moves bulked per job (3rd of the 3 per-task
             # moves on the apply path; see JobInfo.update_tasks_status).
             for job, group in by_job.values():
@@ -1179,6 +1315,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     hostname, why, ti.namespace, ti.name,
                     prior_status.name,
                 )
+                # A reverted bind is terminally not-applied: resolve
+                # the intent (post-mutex) so the record can self-clean.
+                failed_marks.append(stored.uid)
 
             # Node accounting grouped per node (one aggregate idle/used
             # update; fallback policy in NodeInfo.add_tasks_with_fallback).
@@ -1204,6 +1343,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     else:
                         revert(ti, stored, job, prior, hostname,
                                "rejected")
+
+        self._journal_mark_many(
+            journal_seq, {uid: "failed" for uid in failed_marks}
+        )
 
         # Pre-warm the COW snapshot pool for everything this batch
         # dirtied: re-clone the touched jobs/nodes HERE, on the
@@ -1233,8 +1376,17 @@ class SchedulerCache(Cache, EventHandlersMixin):
 
         if self.binder is not None:
             def _do_binds(chunk):
+                # Chunked drain: journal marks collected per chunk and
+                # flushed in one round trip (one Lease CAS on a real
+                # cluster) — the fenced case leaves no sink entry, so
+                # those intents stay open for the successor.
+                marks: Dict[str, str] = {}
                 for pod, hostname, task_snapshot in chunk:
-                    self._bind_side_effect(pod, hostname, task_snapshot)
+                    self._bind_side_effect(
+                        pod, hostname, task_snapshot,
+                        journal_seq=journal_seq, mark_sink=marks,
+                    )
+                self._journal_mark_many(journal_seq, marks)
 
             for start in range(0, len(binds), self._BIND_CHUNK):
                 chunk = binds[start:start + self._BIND_CHUNK]
@@ -1242,7 +1394,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
             for pod, hostname, task_snapshot in slow_binds:
                 self._submit_side_effect(
                     lambda p=pod, h=hostname, s=task_snapshot:
-                        self._bind_side_effect(p, h, s)
+                        self._bind_side_effect(
+                            p, h, s, journal_seq=journal_seq
+                        )
                 )
         if on_accepted is not None:
             try:

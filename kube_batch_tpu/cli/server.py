@@ -103,7 +103,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         # loop watchdog, and the leadership fence — one curl says
         # whether (and why) the scheduler is running on a lower rung.
         try:
-            from ..scheduler import ACTIVE_WATCHDOG
+            from ..cache import recovery as cache_recovery
+            from ..scheduler import ACTIVE_WATCHDOG, LEASE_TTL_CHECK
             from ..solver import containment
 
             cache = TELEMETRY.attached_cache()
@@ -120,6 +121,11 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 ),
                 "watchdog_trips": metrics.scheduler_watchdog_trips.get(),
                 "cache_fence": fence_fn() if fence_fn else None,
+                # Failover surface: the startup journal-recovery pass's
+                # outcome (None = clean start / no journal seam) and
+                # the lease-TTL sanity verdict vs the watchdog budget.
+                "recovery": cache_recovery.LAST_RECOVERY,
+                "lease_ttl": LEASE_TTL_CHECK,
             }
         except Exception:  # pragma: no cover - probes must not 500
             logger.exception("/debug/vars robustness probe failed")
@@ -484,6 +490,13 @@ def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
         if opt.once:
             cache.run(stop)
             cache.wait_for_cache_sync(stop)
+            # Same recovery discipline as the loop: a --once run on a
+            # cluster with surviving bind intents reconciles them
+            # before its single cycle plans on top.
+            try:
+                sched.recover_from_journal()
+            except Exception:
+                logger.exception("--once journal recovery failed")
             sched.run_once()
             # Binds/evicts execute on the cache's async pool; barrier so
             # callers observe the fully-applied schedule after run().
@@ -504,7 +517,16 @@ def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
 
         opt.check_option_or_die()
         identity = f"{os.uname().nodename}-{os.getpid()}"
+        # Journal records carry the elector identity, so a successor's
+        # recovery can tell a dead predecessor's intents from its own;
+        # the real-cluster journal Lease co-lives with the leader lock.
+        cache.leader_identity = identity
         if getattr(cluster, "supports_lease_election", False):
+            # Real-cluster journal Lease co-lives with the leader lock
+            # (lock_object_namespace is a k8s namespace here; for the
+            # file elector below it is a directory path).
+            if hasattr(cluster, "journal_namespace"):
+                cluster.journal_namespace = opt.lock_object_namespace
             # Real-cluster mode: the lock object lives in the API server
             # (coordination/v1 Lease — the reference's ConfigMap
             # resourcelock analog, server.go:113-141), so failover works
@@ -521,6 +543,10 @@ def run(opt: ServerOption, cluster: Optional[ClusterAPI] = None,
         # so a healthy instance can take over while the cache fence
         # keeps this process's side-effect threads from issuing binds.
         sched.fence_hooks.append(elector.fence)
+        # Lease-TTL sanity: warn (and export at /debug/vars) when the
+        # lease can expire under a healthy-but-slow leader before the
+        # watchdog would fence it.
+        sched.check_lease_ttl(elector.lease_duration)
         try:
             elector.run(
                 on_started_leading=run_scheduler,

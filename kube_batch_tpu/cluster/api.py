@@ -64,6 +64,57 @@ class ClusterAPI:
     def release_pod_volumes(self, pod: Pod) -> None:
         return None
 
+    # -- bind-intent journal (optional capability) --------------------------
+    # Crash-tolerant failover seam (doc/design/robustness.md, failover
+    # section): the scheduler appends a durable intent record per bind
+    # batch BEFORE any bind side effect is issued, and marks each task
+    # applied/failed as the side effects drain. A successor leader
+    # reconciles the surviving intents against cluster truth
+    # (cache/recovery.py) so a leader killed mid-bind-drain never
+    # leaves a half-applied gang placement behind unclassifiable.
+    # Implementations: in-memory store (InProcessCluster), Lease
+    # annotation (KubeCluster). ``supports_bind_journal = False`` means
+    # the cache skips journaling entirely.
+
+    supports_bind_journal = False
+
+    def append_bind_intent(self, record: dict) -> int:
+        """Durably append one intent record; returns the journal's
+        monotone sequence number assigned to it."""
+        raise NotImplementedError
+
+    def mark_bind_intent(self, seq: int, task_uid: str, outcome: str) -> bool:
+        """Mark one task of intent ``seq`` as ``applied`` or ``failed``.
+        Returns True iff the record became fully resolved (every task
+        marked) and was pruned from the journal."""
+        raise NotImplementedError
+
+    def mark_bind_intents(self, seq: int, marks: Dict[str, str]) -> bool:
+        """Batched :meth:`mark_bind_intent` for one bind chunk's drain.
+        The default loops (in sorted order, for determinism); backends
+        whose mark is a network CAS override with ONE round trip —
+        per-task marks on a 50k-gang batch would otherwise be
+        O(tasks x journal-size) API-server traffic."""
+        resolved = False
+        for uid in sorted(marks):
+            resolved = self.mark_bind_intent(seq, uid, marks[uid]) or resolved
+        return resolved
+
+    def list_bind_intents(self) -> List[dict]:
+        """All live intent records, ascending by seq."""
+        raise NotImplementedError
+
+    def remove_bind_intent(self, seq: int) -> None:
+        raise NotImplementedError
+
+    def remove_bind_intents(self, seqs) -> None:
+        """Batched prune (the successor's end-of-recovery sweep). The
+        default loops; network-CAS backends override with ONE round
+        trip — per-record prune of a full journal is O(records) GET+PUT
+        of the whole annotation otherwise."""
+        for seq in sorted(seqs):
+            self.remove_bind_intent(seq)
+
     # -- reads / watches ----------------------------------------------------
 
     def list_objects(self, kind: str) -> List[object]:
@@ -73,6 +124,11 @@ class ClusterAPI:
         raise NotImplementedError
 
     def add_watch(self, handler: WatchHandler) -> None:
+        raise NotImplementedError
+
+    def remove_watch(self, handler: WatchHandler) -> None:
+        """Detach a previously added watch handler (failover teardown:
+        a dead scheduler instance must stop observing the cluster)."""
         raise NotImplementedError
 
     # -- writes (the scheduler's side effects) ------------------------------
@@ -136,6 +192,18 @@ class InProcessCluster(ClusterAPI):
         # need no polling.
         self._claims: Dict[str, Dict] = {}
         self._claims_changed = threading.Condition(self._lock)
+        # Bind-intent journal (crash-tolerant failover): seq -> record.
+        # Records self-clean when fully marked (mark_bind_intent), so
+        # the steady-state journal holds only in-flight batches.
+        self._journal: Dict[int, dict] = {}
+        self._journal_seq = 0
+        self._journal_warned = False
+        # Lease store ("ns/name" -> {holder, renew_ts, transitions}):
+        # the KubeCluster coordination/v1 Lease analog, used by the
+        # failover drill's lease handoff (sim/harness.py). The server's
+        # elector selection keys on supports_lease_election, which
+        # stays False here — single-host runs keep the file lease.
+        self._leases: Dict[str, Dict] = {}
 
     # -- internal -----------------------------------------------------------
 
@@ -176,6 +244,133 @@ class InProcessCluster(ClusterAPI):
     def add_watch(self, handler: WatchHandler) -> None:
         with self._lock:
             self._watchers.append(handler)
+
+    def remove_watch(self, handler: WatchHandler) -> None:
+        with self._lock:
+            try:
+                self._watchers.remove(handler)
+            except ValueError:
+                pass
+
+    # -- bind-intent journal -------------------------------------------------
+
+    supports_bind_journal = True
+
+    # Soft cap on live (unresolved) records: the journal self-cleans on
+    # resolution, so sustained growth past this means marks are not
+    # draining — warn once rather than dropping recoverability.
+    JOURNAL_SOFT_CAP = 4096
+
+    def append_bind_intent(self, record: dict) -> int:
+        with self._lock:
+            self._journal_seq += 1
+            seq = self._journal_seq
+            rec = dict(record)
+            rec["seq"] = seq
+            rec.setdefault("marks", {})
+            rec.setdefault("tasks", [])
+            self._journal[seq] = rec
+            over = (
+                len(self._journal) > self.JOURNAL_SOFT_CAP
+                and not self._journal_warned
+            )
+            if over:
+                self._journal_warned = True
+        if over:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "bind-intent journal exceeds %d live records — bind "
+                "side effects are not draining their applied/failed "
+                "marks", self.JOURNAL_SOFT_CAP,
+            )
+        return seq
+
+    def mark_bind_intent(self, seq: int, task_uid: str, outcome: str) -> bool:
+        with self._lock:
+            rec = self._journal.get(seq)
+            if rec is None:
+                return False
+            rec["marks"][task_uid] = outcome
+            if all(t["uid"] in rec["marks"] for t in rec["tasks"]):
+                # Fully resolved: every task's bind either landed
+                # (applied) or was reverted/resynced (failed) — nothing
+                # left for a successor to classify. Self-cleaning keeps
+                # the journal O(in-flight batches), not O(history).
+                del self._journal[seq]
+                return True
+            return False
+
+    def mark_bind_intents(self, seq: int, marks: Dict[str, str]) -> bool:
+        """One lock hold for a whole chunk's marks."""
+        if not marks:
+            return False
+        with self._lock:
+            rec = self._journal.get(seq)
+            if rec is None:
+                return False
+            rec["marks"].update(marks)
+            if all(t["uid"] in rec["marks"] for t in rec["tasks"]):
+                del self._journal[seq]
+                return True
+            return False
+
+    def list_bind_intents(self) -> List[dict]:
+        with self._lock:
+            return [
+                {**rec, "tasks": [dict(t) for t in rec["tasks"]],
+                 "marks": dict(rec["marks"])}
+                for _, rec in sorted(self._journal.items())
+            ]
+
+    def remove_bind_intent(self, seq: int) -> None:
+        with self._lock:
+            self._journal.pop(seq, None)
+
+    def remove_bind_intents(self, seqs) -> None:
+        with self._lock:
+            for seq in seqs:
+                self._journal.pop(seq, None)
+
+    # -- leases (KubeCluster try_acquire_lease analog) -----------------------
+
+    def try_acquire_lease(self, namespace: str, name: str, identity: str,
+                          lease_duration: float,
+                          now: Optional[float] = None) -> bool:
+        """CAS on the in-memory lease: take when free, held by this
+        identity, or expired (renew_ts older than lease_duration).
+        ``now`` is injectable so the simulator's failover drill drives
+        expiry on the virtual clock (replay-deterministic takeover)."""
+        now = time.time() if now is None else now
+        key = f"{namespace}/{name}"
+        with self._lock:
+            lease = self._leases.get(key)
+            if lease is not None and lease["holder"] not in ("", identity):
+                if now - lease["renew_ts"] <= lease_duration:
+                    return False
+            taken_over = lease is None or lease["holder"] != identity
+            self._leases[key] = {
+                "holder": identity,
+                "renew_ts": now,
+                "transitions": (
+                    (lease["transitions"] + 1) if lease is not None
+                    and taken_over else
+                    (lease["transitions"] if lease is not None else 0)
+                ),
+            }
+            return True
+
+    def release_lease(self, namespace: str, name: str, identity: str) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            lease = self._leases.get(key)
+            if lease is not None and lease["holder"] == identity:
+                lease["holder"] = ""
+
+    def read_lease(self, namespace: str, name: str) -> Optional[Dict]:
+        with self._lock:
+            lease = self._leases.get(f"{namespace}/{name}")
+            return dict(lease) if lease is not None else None
 
     # -- typed conveniences ---------------------------------------------------
 

@@ -955,6 +955,213 @@ class KubeCluster(ClusterAPI):
         except Exception:
             logger.debug("lease release failed", exc_info=True)
 
+    # -- bind-intent journal (Lease-annotation analog) -----------------------
+    # The in-process store's durable twin for real clusters: the journal
+    # rides as one JSON annotation on a dedicated coordination/v1 Lease
+    # object, CAS-updated through the API server's resourceVersion (the
+    # same optimistic-concurrency channel the leader lock uses). A
+    # successor on ANY host reads the dead leader's intents back before
+    # its first cycle (cache/recovery.py). The annotation is bounded:
+    # records self-clean on full resolution, and an over-cap journal
+    # drops its OLDEST records with a loud warning rather than failing
+    # binds (availability over perfect recoverability).
+
+    supports_bind_journal = True
+
+    JOURNAL_LEASE_NAME = "tpu-batch-bind-journal"
+    JOURNAL_ANNOTATION = "tpu-batch.io/bind-journal"
+    JOURNAL_MAX_RECORDS = 512
+    # Namespace for the journal Lease; cli/server.py stamps the
+    # elector's lock namespace here so journal and leader lock co-live.
+    journal_namespace = "kube-system"
+
+    def _journal_lease_path(self) -> str:
+        return self.LEASE_PATH.format(
+            ns=self.journal_namespace, name=self.JOURNAL_LEASE_NAME
+        )
+
+    def _read_journal(self):
+        """(lease doc | None, journal dict). Missing lease or an
+        unparseable annotation reads as an empty journal."""
+        try:
+            lease = self._request("GET", self._journal_lease_path())
+        except urlerror.HTTPError as e:
+            if e.code != 404:
+                raise
+            return None, {"next_seq": 1, "records": []}
+        anns = (lease.get("metadata", {}) or {}).get("annotations", {}) or {}
+        raw = anns.get(self.JOURNAL_ANNOTATION, "")
+        try:
+            journal = json.loads(raw) if raw else {}
+        except ValueError:
+            journal = None
+        if not isinstance(journal, dict):
+            # Unparseable OR valid-JSON-but-not-an-object (a corrupted
+            # or hand-edited annotation): both read as an empty journal
+            # — one bad write must not brick every later operation.
+            logger.warning("bind-journal annotation unusable; resetting")
+            journal = {}
+        journal.setdefault("next_seq", 1)
+        journal.setdefault("records", [])
+        return lease, journal
+
+    # Byte budget for the journal annotation: the API server caps TOTAL
+    # annotations at 256 KiB, and exceeding it fails the PUT with 422 —
+    # which _journal_cas does NOT retry, so an oversized journal would
+    # silently stop journaling exactly the big gang batches failover
+    # recovery exists for. Stay well under the cap (other annotations
+    # share the object) by shedding the OLDEST records first.
+    JOURNAL_MAX_BYTES = 196 * 1024
+
+    def _write_journal(self, lease, journal) -> None:
+        """PUT (or POST, when the Lease doesn't exist yet) the journal
+        annotation back; raises HTTPError 409 on a lost CAS race."""
+        if len(journal["records"]) > self.JOURNAL_MAX_RECORDS:
+            dropped = len(journal["records"]) - self.JOURNAL_MAX_RECORDS
+            journal["records"] = journal["records"][-self.JOURNAL_MAX_RECORDS:]
+            logger.warning(
+                "bind-intent journal over %d records; dropped the %d "
+                "oldest (their tasks rely on resync, not recovery)",
+                self.JOURNAL_MAX_RECORDS, dropped,
+            )
+        blob = json.dumps(journal, sort_keys=True)
+        shed = 0
+        # Never shed the NEWEST record: on the append path it is the
+        # record being written, and silently dropping it while the
+        # caller keeps a seq would report a journaled batch that is
+        # not recoverable.
+        while (
+            len(blob.encode()) > self.JOURNAL_MAX_BYTES
+            and len(journal["records"]) > 1
+        ):
+            journal["records"].pop(0)
+            shed += 1
+            blob = json.dumps(journal, sort_keys=True)
+        if shed:
+            logger.warning(
+                "bind-intent journal annotation over %d bytes; shed "
+                "the %d oldest record(s) to fit the k8s annotation cap "
+                "(their tasks rely on resync, not recovery)",
+                self.JOURNAL_MAX_BYTES, shed,
+            )
+        if len(blob.encode()) > self.JOURNAL_MAX_BYTES:
+            # A single record alone busts the budget (a huge gang
+            # batch): refuse the write LOUDLY — append_bind_intent then
+            # raises, the cache logs 'binds proceed unjournaled', and
+            # the task falls back to the resync contract, instead of
+            # returning a seq for a record that was never stored.
+            raise ValueError(
+                f"bind-intent record of {len(blob.encode())} bytes "
+                f"exceeds the {self.JOURNAL_MAX_BYTES}-byte annotation "
+                "budget; this batch is not journal-recoverable"
+            )
+        if lease is None:
+            self._request(
+                "POST",
+                self.LEASES_PATH.format(ns=self.journal_namespace), body={
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {
+                        "name": self.JOURNAL_LEASE_NAME,
+                        "namespace": self.journal_namespace,
+                        "annotations": {self.JOURNAL_ANNOTATION: blob},
+                    },
+                    "spec": {},
+                })
+            return
+        meta = lease.setdefault("metadata", {})
+        anns = meta.get("annotations") or {}
+        anns[self.JOURNAL_ANNOTATION] = blob
+        meta["annotations"] = anns
+        self._request("PUT", self._journal_lease_path(), body=lease)
+
+    def _journal_cas(self, mutate):
+        """GET → mutate(journal) → PUT, retried over CAS conflicts.
+        ``mutate`` returns the call's result (and may raise to abort).
+
+        Per-task marks arrive from up to four concurrent side-effect
+        workers, all CAS-ing one Lease — hence a deepish retry budget
+        with a short linear backoff (worst observed contention is the
+        worker count, so ~3 collisions is the expected ceiling; 12
+        attempts is comfortably past it). A dropped APPLIED mark is
+        safe by design (recovery classifies unmarked-but-bound from
+        cluster truth), so retry exhaustion costs journal hygiene, not
+        correctness. If per-mark CAS traffic ever matters at scale,
+        the seam is ready for a coalesced per-chunk mark instead."""
+        last: Optional[Exception] = None
+        for attempt in range(12):
+            lease, journal = self._read_journal()
+            result = mutate(journal)
+            try:
+                self._write_journal(lease, journal)
+                return result
+            except urlerror.HTTPError as e:
+                if e.code not in (409, 404):
+                    raise
+                last = e
+                time.sleep(min(0.25, 0.02 * attempt))
+        raise RuntimeError(f"bind-journal CAS retries exhausted: {last}")
+
+    def append_bind_intent(self, record: dict) -> int:
+        def mutate(journal):
+            seq = int(journal["next_seq"])
+            journal["next_seq"] = seq + 1
+            rec = dict(record)
+            rec["seq"] = seq
+            rec.setdefault("marks", {})
+            journal["records"].append(rec)
+            return seq
+
+        return self._journal_cas(mutate)
+
+    def mark_bind_intent(self, seq: int, task_uid: str, outcome: str) -> bool:
+        return self.mark_bind_intents(seq, {task_uid: outcome})
+
+    def mark_bind_intents(self, seq: int, marks) -> bool:
+        """One CAS round trip for a whole bind chunk's marks — the
+        cache drains chunks of up to _BIND_CHUNK tasks, so per-task
+        CAS would be O(tasks x journal-size) API-server traffic with
+        four workers contending on one resourceVersion."""
+        if not marks:
+            return False
+
+        def mutate(journal):
+            records = journal["records"]
+            for i, rec in enumerate(records):
+                if rec.get("seq") == seq:
+                    rec.setdefault("marks", {}).update(marks)
+                    if all(
+                        t["uid"] in rec["marks"] for t in rec["tasks"]
+                    ):
+                        del records[i]
+                        return True
+                    return False
+            return False
+
+        return self._journal_cas(mutate)
+
+    def list_bind_intents(self):
+        _, journal = self._read_journal()
+        return sorted(journal["records"], key=lambda r: r.get("seq", 0))
+
+    def remove_bind_intent(self, seq: int) -> None:
+        self.remove_bind_intents((seq,))
+
+    def remove_bind_intents(self, seqs) -> None:
+        """One CAS for the successor's end-of-recovery sweep — a
+        per-record prune of a 512-record journal would be 512 full
+        GET+PUT round trips of the whole annotation."""
+        gone = set(seqs)
+        if not gone:
+            return
+
+        def mutate(journal):
+            journal["records"] = [
+                r for r in journal["records"] if r.get("seq") not in gone
+            ]
+
+        self._journal_cas(mutate)
+
     def record_event(self, obj, event_type: str, reason: str,
                      message: str) -> None:
         """Best-effort core/v1 Event POST (the reference's event

@@ -479,6 +479,32 @@ cache_binds_fenced = REGISTRY.register(
         "the cluster)",
     )
 )
+# Crash-tolerant failover (doc/design/robustness.md, failover section):
+# the bind-intent journal's lifecycle and the successor recovery pass's
+# per-task reconciliation outcomes.
+bind_journal_intents = REGISTRY.register(
+    Counter(
+        "bind_journal_intents_total",
+        "Bind-intent journal events: appended (one per dispatched "
+        "batch), applied/failed (one per task as its side effect "
+        "drains), resolved (records fully marked and self-pruned)",
+    ),
+    ("event",),
+)
+scheduler_failover_recoveries = REGISTRY.register(
+    Counter(
+        "scheduler_failover_recoveries_total",
+        "Successor-recovery task reconciliations by outcome: applied "
+        "(bind landed; confirmed or mark back-filled), failed (the "
+        "dead leader already reverted it), redriven (lost bind "
+        "re-issued to its journaled node to complete a partial gang), "
+        "requeued (lost bind left to normal scheduling), evicted "
+        "(partial gang below minMember torn down — all-or-nothing "
+        "restored), superseded (another leader placed it elsewhere), "
+        "vanished (pod gone)",
+    ),
+    ("outcome",),
+)
 sim_cycles = REGISTRY.register(
     Counter("sim_cycles_total", "Simulated scheduling cycles driven")
 )
@@ -785,6 +811,18 @@ def update_telemetry_watermarks(
                 queue_fairness_drift.remove(labels)
         for queue, v in fairness.items():
             queue_fairness_drift.set(v, (queue,))
+
+
+def register_journal_event(event: str) -> None:
+    """One bind-intent journal lifecycle event (cache/cache.py)."""
+    bind_journal_intents.inc((event,))
+
+
+def register_failover_recovery(outcome: str, count: int = 1) -> None:
+    """``count`` task reconciliations with ``outcome`` from one
+    successor recovery pass (cache/recovery.py)."""
+    if count:
+        scheduler_failover_recoveries.inc((outcome,), amount=float(count))
 
 
 def register_sim_cycle() -> None:

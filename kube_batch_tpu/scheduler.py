@@ -29,6 +29,11 @@ logger = logging.getLogger(__name__)
 # degraded-mode surface reads the live state from here.
 ACTIVE_WATCHDOG: Optional["LoopWatchdog"] = None
 
+# Most recent lease-TTL sanity verdict (Scheduler.check_lease_ttl —
+# called by cli/server.py once the elector exists); surfaced in
+# /debug/vars' robustness block like ACTIVE_WATCHDOG.
+LEASE_TTL_CHECK: Optional[dict] = None
+
 
 class LoopWatchdog:
     """No-cycle-progress detector: the last line of the solver
@@ -284,6 +289,67 @@ class Scheduler:
             with open(confstr) as f:
                 confstr = f.read()
         self.actions, self.tiers = load_scheduler_conf(confstr)
+        # Successor-recovery note for the first post-recovery cycle's
+        # flight record (recover_from_journal sets it; run_once drains
+        # it into RECORDER.annotate("recovery", ...)).
+        self._pending_recovery_note: Optional[dict] = None
+
+    def check_lease_ttl(self, lease_duration: float) -> dict:
+        """Lease-TTL sanity check (called by the server once the
+        elector exists): a lease TTL shorter than the watchdog's
+        no-progress budget means a healthy-but-slow leader — one the
+        watchdog would deliberately NOT fence, e.g. a cycle riding the
+        degradation ladder through three budget-bounded rung attempts —
+        can lose its lease mid-cycle if it stalls hard enough to miss
+        renewals, handing the cluster a split recovery the fencing
+        order was designed to prevent. Warn loudly and export the
+        verdict (/debug/vars robustness.lease_ttl)."""
+        global LEASE_TTL_CHECK
+
+        verdict = {
+            "lease_duration_seconds": float(lease_duration),
+            "watchdog_budget_seconds": float(self.watchdog_budget),
+            "sane": (
+                self.watchdog_budget <= 0
+                or lease_duration >= self.watchdog_budget
+            ),
+        }
+        if not verdict["sane"]:
+            logger.warning(
+                "elector lease TTL %.1fs is SHORTER than the watchdog "
+                "no-progress budget %.1fs: a healthy-but-slow leader "
+                "can lose its lease mid-cycle before the watchdog "
+                "would fence it — raise the lease duration or lower "
+                "KBT_WATCHDOG_BUDGET",
+                lease_duration, self.watchdog_budget,
+            )
+        LEASE_TTL_CHECK = verdict
+        return verdict
+
+    def recover_from_journal(self):
+        """Successor recovery pass (cache/recovery.py): after lease
+        acquisition and cache sync, reconcile the bind-intent journal
+        a dead predecessor left behind against cluster truth — classify
+        every in-flight bind, re-drive or revert, repair partial gangs
+        — BEFORE the first scheduling cycle plans against a state it
+        doesn't understand. Returns the RecoveryReport, or None when
+        the cluster has no journal seam or KBT_RECOVERY=0."""
+        cluster = getattr(self.cache, "cluster", None)
+        if cluster is None or not getattr(
+            cluster, "supports_bind_journal", False
+        ):
+            return None
+        if os.environ.get("KBT_RECOVERY", "1") == "0":
+            return None
+        from .cache.recovery import reconcile_journal
+
+        identity = getattr(
+            self.cache, "leader_identity", f"scheduler-{os.getpid()}"
+        )
+        report = reconcile_journal(cluster, identity)
+        if report.intents_scanned or report.tasks_classified:
+            self._pending_recovery_note = report.summary()
+        return report
 
     def run_once_guarded(self) -> bool:
         """One cycle that cannot kill the loop: exceptions are logged,
@@ -350,6 +416,15 @@ class Scheduler:
             ACTIVE_WATCHDOG = self.watchdog
         self.cache.run(stop)
         self.cache.wait_for_cache_sync(stop)
+        # Failover recovery BEFORE the first cycle: a successor must
+        # classify the dead predecessor's in-flight binds (and repair
+        # any gang left below minMember) before planning placements on
+        # top of them. Guarded — a recovery error must not keep a
+        # healthy leader from scheduling.
+        try:
+            self.recover_from_journal()
+        except Exception:
+            logger.exception("startup journal recovery failed; continuing")
         if self.micro_enabled:
             # Arm the arrival wake-up: pending pods of ours landing in
             # the mirror set the event the think-time wait below parks
@@ -540,6 +615,13 @@ class Scheduler:
         self._cycle_count += 1
         TRACER.begin_cycle(cycle)
         RECORDER.begin_cycle(cycle)
+        if self._pending_recovery_note is not None:
+            # First post-recovery cycle: the failover reconciliation's
+            # outcome rides in this cycle's flight record, so an error
+            # dump (or the sim's trace) shows what recovery changed
+            # underneath the cycle that then ran.
+            RECORDER.annotate("recovery", self._pending_recovery_note)
+            self._pending_recovery_note = None
         if self.watchdog is not None:
             self.watchdog.cycle_begin(cycle)
         cycle_start = time.perf_counter()

@@ -6,7 +6,9 @@ breaks the contract must fail CI); 2 replay placement mismatch;
 leak/drift detector trip (``--soak``); 5 the sharded-sparse engagement
 assert failed (``--require-sparse-sharded`` — the run never solved
 through the multi-device sparse path, or ``--host-devices`` could not
-re-shape an already-initialized backend).
+re-shape an already-initialized backend); 6 the failover drill was
+incomplete (``--require-kill-cuts`` — a required leader-kill cut never
+fired, or a successor recovery pass reported errors).
 """
 
 from __future__ import annotations
@@ -30,7 +32,21 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
         "--faults", default="",
         help="fault spec, e.g. 'bind:0.05,node-flap:0.02' (kinds: bind, "
              "node-flap, node-death, evict, solver, crash, solver-exc, "
-             "solver-hang, backend-loss)")
+             "solver-hang, backend-loss, leader-kill)")
+    parser.add_argument(
+        "--kill-at", default="", metavar="CYCLE:CUT,...",
+        help="failover kill drill: hard-stop the leader at the named "
+             "cut point of each listed cycle (cuts: pre-solve, "
+             "post-solve-pre-drain, mid-bind-drain, mid-close); a "
+             "successor takes the lease and runs journal recovery")
+    parser.add_argument(
+        "--require-kill-cuts", default="", metavar="CUT,...|all",
+        help="exit 6 unless a leader kill fired (and its successor "
+             "recovered without errors) at every listed cut point "
+             "('all' = every known cut)")
+    parser.add_argument(
+        "--report-out", default=None, metavar="PATH",
+        help="write the run's JSON report to PATH (drill artifacts)")
     parser.add_argument("--nodes", type=int, default=12)
     parser.add_argument("--node-cpu-m", type=int, default=8000)
     parser.add_argument("--node-mem-mi", type=int, default=16384)
@@ -97,6 +113,28 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
                         help="suppress the JSON report on stdout")
 
 
+def parse_kill_plan(spec: str) -> dict:
+    """``"5:pre-solve,9:mid-close"`` → ``{5: "pre-solve", ...}``.
+    Unknown cuts are hard errors (same typo discipline as the fault
+    spec)."""
+    from .failover import CUT_POINTS
+
+    plan = {}
+    for term in (spec or "").split(","):
+        term = term.strip()
+        if not term:
+            continue
+        cycle_s, sep, cut = term.partition(":")
+        cut = cut.strip()
+        if not sep or cut not in CUT_POINTS:
+            raise ValueError(
+                f"bad --kill-at term {term!r} "
+                f"(cuts: {', '.join(CUT_POINTS)})"
+            )
+        plan[int(cycle_s)] = cut
+    return plan
+
+
 def config_from_args(ns: argparse.Namespace) -> SimConfig:
     queues = {}
     for term in ns.queues.split(","):
@@ -130,6 +168,7 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         replay=replay,
         replay_limit=ns.replay_cycles,
         micro_every=ns.micro_every,
+        kill_plan=parse_kill_plan(ns.kill_at),
         check_invariants=ns.check,
         soak=ns.soak,
         telemetry_out=ns.telemetry_out,
@@ -171,6 +210,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         sharded_solves = int(metrics.solver_sparse_sharded.total())
         out["sparse_sharded_solves"] = sharded_solves
+    if ns.report_out:
+        with open(ns.report_out, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+            f.write("\n")
     if not ns.quiet:
         print(json.dumps(out, indent=2, sort_keys=True))
 
@@ -209,4 +252,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 5
+    if ns.require_kill_cuts:
+        from .failover import CUT_POINTS
+
+        wanted = (
+            list(CUT_POINTS) if ns.require_kill_cuts.strip() == "all"
+            else [c.strip() for c in ns.require_kill_cuts.split(",")
+                  if c.strip()]
+        )
+        fired = {f["cut"] for f in report.failovers}
+        missing = [c for c in wanted if c not in fired]
+        if missing or report.recovery_failures:
+            print(
+                f"sim: failover drill incomplete — missing cuts "
+                f"{missing}, recovery failures "
+                f"{report.recovery_failures} (--require-kill-cuts)",
+                file=sys.stderr,
+            )
+            return 6
     return 0

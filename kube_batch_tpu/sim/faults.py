@@ -10,7 +10,8 @@ Spec grammar (doc/design/simulator.md): comma-separated
 | ``node-death`` | mid-cycle      | node doomed for the cycle: every bind to it fails AND the first one deletes the node under the in-flight batch; permanent |
 | ``evict``      | pre-cycle      | one seeded Running pod deleted (external eviction race); recreated Pending |
 | ``solver``     | per-cycle env  | forces ``KBT_SOLVER=native`` for the cycle (accelerator-backend failure → native fallback) |
-| ``crash``      | action shim    | a raising action is prepended for the cycle, exercising the scheduler's guarded-cycle error backoff |
+| ``crash``      | action shim    | in-cycle EXCEPTION injection: a raising action is prepended for the cycle; the SAME process absorbs it through the guarded-cycle error backoff and keeps scheduling. NOT a crash analog for process death — see ``leader-kill`` |
+| ``leader-kill``| cluster endpoint | PROCESS-death analog: the leader is hard-stopped at a seeded cut point (``pre-solve`` / ``post-solve-pre-drain`` / ``mid-bind-drain`` / ``mid-close``, sim/failover.py) — nothing fences, nothing unwinds, its surviving writes stay in the cluster; a successor instance takes the lease and runs journal recovery (cache/recovery.py) |
 | ``solver-exc`` | device-fault hook | the device-solve materialization raises for the cycle; the containment ladder must re-solve on a lower rung |
 | ``solver-hang``| device-fault hook | the device-solve materialization outsleeps the solve budget; the fetch deadline must abandon it and drop to native |
 | ``backend-loss``| device-fault hook | device solves AND the breaker's canary probe raise for a seeded 1-4 cycles (device lost); the breaker must hold open until the window closes, then re-promote |
@@ -44,7 +45,7 @@ from ..utils.lockdebug import wrap_lock
 
 FAULT_KINDS = (
     "bind", "node-flap", "node-death", "evict", "solver", "crash",
-    "solver-exc", "solver-hang", "backend-loss",
+    "solver-exc", "solver-hang", "backend-loss", "leader-kill",
 )
 
 
@@ -202,6 +203,13 @@ class FaultInjector:
         if p_loss and rng.random() < p_loss:
             events.append({
                 "kind": "backend-loss", "down_for": rng.randint(1, 4),
+            })
+        p_kill = spec.get("leader-kill", 0.0)
+        if p_kill and rng.random() < p_kill:
+            from .failover import CUT_POINTS
+
+            events.append({
+                "kind": "leader-kill", "cut": rng.choice(CUT_POINTS),
             })
         return events
 

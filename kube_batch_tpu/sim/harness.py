@@ -36,6 +36,7 @@ from ..obs.tracer import TRACER
 from ..scheduler import Scheduler
 from ..utils.test_utils import build_node, build_pod, build_pod_group, build_queue
 from .clock import VirtualClock
+from .failover import CUT_POINTS, SimClusterEndpoint
 from .faults import FaultInjector, parse_fault_spec
 from .invariants import InvariantChecker
 from .trace import TRACE_VERSION, TraceReader, TraceWriter
@@ -102,6 +103,13 @@ class SimConfig:
     # still runs EVERY cycle, so the micro path carries the same
     # correctness obligations as the periodic one. 0 disables.
     micro_every: int = 0
+    # Failover kill drill (--kill-at): cycle -> cut point; the leader
+    # is hard-stopped at that cut (sim/failover.py) and a successor
+    # instance takes the lease and recovers. Probabilistic kills ride
+    # the fault spec as leader-kill:p instead.
+    kill_plan: Dict[int, str] = field(default_factory=dict)
+    # Virtual-time lease TTL for the drill's takeover wait.
+    lease_duration: float = 15.0
 
 
 @dataclass
@@ -129,6 +137,11 @@ class SimReport:
     # chaos run asserts re-promotion (state == closed once the injected
     # fault windows end) straight off the report.
     breaker: Optional[dict] = None
+    # Failover drill bookkeeping: one entry per leader kill (cut,
+    # cycle, takeover wait, recovery outcome summary).
+    leader_kills: int = 0
+    failovers: List[dict] = field(default_factory=list)
+    recovery_failures: int = 0
 
     @property
     def cycles_per_sec(self) -> float:
@@ -155,6 +168,11 @@ class SimReport:
             **({"soak": self.soak} if self.soak is not None else {}),
             **({"breaker": self.breaker} if self.breaker is not None
                else {}),
+            **({
+                "leader_kills": self.leader_kills,
+                "failovers": list(self.failovers),
+                "recovery_failures": self.recovery_failures,
+            } if self.leader_kills else {}),
         }
 
 
@@ -190,8 +208,12 @@ class ClusterSimulator:
             cfg.faults = header.get("faults", cfg.faults)
             cfg.period = header.get("period", cfg.period)
             # The cycle-kind schedule (periodic vs micro) is part of
-            # the recorded run's semantics.
+            # the recorded run's semantics; so is the drill's lease TTL
+            # (it decides the recorded takeover wait).
             cfg.micro_every = header.get("micro_every", cfg.micro_every)
+            cfg.lease_duration = header.get(
+                "lease_duration", cfg.lease_duration
+            )
             cfg.cycles = len(cfg.replay.cycles)
             if cfg.replay_limit is not None:
                 cfg.cycles = min(cfg.cycles, max(1, cfg.replay_limit))
@@ -225,27 +247,37 @@ class ClusterSimulator:
 
         self._containment = _containment
         _containment.reset_breaker()
+        # Failover drill state: device-kind memo (successor instances
+        # must re-stamp the 0.5 s solve budget their Scheduler
+        # construction resets) and the kill switchboard.
+        self._device_kinds = device_kinds
+        for cut in sorted(set(cfg.kill_plan.values())):
+            if cut not in CUT_POINTS:
+                raise ValueError(
+                    f"unknown leader-kill cut {cut!r} "
+                    f"(known: {', '.join(CUT_POINTS)})"
+                )
+        self._failover_enabled = (
+            bool(fault_spec.get("leader-kill")) or bool(cfg.kill_plan)
+        )
+        if cfg.replay is not None and not self._failover_enabled:
+            # Replay re-applies kills from the RECORDED fault events,
+            # so lease bookkeeping (whose takeover wait is part of the
+            # compared failover block) must arm off the trace, not the
+            # (empty) CLI spec.
+            self._failover_enabled = any(
+                f.get("kind") == "leader-kill"
+                for rec in cfg.replay.cycles
+                for f in rec.get("faults", [])
+            )
+        self.instance_id = 0
         try:
             self.cluster = InProcessCluster(simulate_kubelet=True)
-            self.cache = SchedulerCache(
-                cluster=self.cluster,
-                scheduler_name="tpu-batch",
-                default_queue="default",
-            )
             self.injector = FaultInjector(fault_spec, cfg.seed)
             self.injector.attach_cluster(self.cluster)
-            self.cache.binder = self.binder = _RecordingBinder(
-                self.injector.wrap_binder(self.cache.binder)
-            )
-            # Ingest without the background resync/cleanup loops: the
-            # sim drains those queues itself at deterministic points.
-            self.cache.start_ingest()
-            self.scheduler = Scheduler(
-                self.cache,
-                scheduler_conf=cfg.conf,
-                schedule_period=cfg.period,
-                clock=self.clock,
-            )
+            # The active scheduler instance (endpoint/cache/binder/
+            # scheduler); failover discards it and builds a successor.
+            self._build_instance()
             # Small REAL-time solve budget, stamped AFTER the Scheduler
             # (whose constructor stamps the period-derived one): an
             # injected hang costs a fraction of a second of wall time,
@@ -255,8 +287,7 @@ class ClusterSimulator:
             # turn a >0.5 s scheduling stall of a healthy solve into a
             # SolveTimeout cycle error. The hook is the chaos seam the
             # solver-exc/solver-hang/backend-loss kinds fire through.
-            if device_kinds:
-                _containment.configure(solve_budget=0.5)
+            # (_build_instance re-stamps it for successors too.)
             _containment.set_device_fault_hook(
                 self.injector.device_fault_hook()
             )
@@ -398,8 +429,15 @@ class ClusterSimulator:
                 "backend": cfg.backend,
                 "period": cfg.period,
                 "micro_every": cfg.micro_every,
+                "lease_duration": cfg.lease_duration,
                 "workload": cfg.workload.to_dict(),
             }
+            if cfg.kill_plan:
+                # Provenance only — replay re-applies kills from the
+                # recorded fault events, not from the plan.
+                header["kill_plan"] = {
+                    str(c): cut for c, cut in sorted(cfg.kill_plan.items())
+                }
         self.writer.write(header)
 
     def _bootstrap(self) -> None:
@@ -407,6 +445,122 @@ class ClusterSimulator:
             return  # cycle 0's recorded events carry the bootstrap
         for event in self.generator.initial_events():
             self._scheduled.setdefault(0, []).append(event)
+
+    # -- scheduler instances (failover drill) --------------------------------
+
+    def _build_instance(self) -> None:
+        """(Re)build the ACTIVE scheduler instance: its own cluster
+        endpoint (the process-death seam, sim/failover.py), a fresh
+        SchedulerCache ingesting the shared cluster, the recording
+        binder stack, and a real Scheduler. Instance 0 is the bootstrap
+        leader; later instances are failover successors."""
+        cfg = self.cfg
+        self.endpoint = SimClusterEndpoint(self.cluster, cfg.seed)
+        self.cache = SchedulerCache(
+            cluster=self.endpoint,
+            scheduler_name="tpu-batch",
+            default_queue="default",
+        )
+        self.cache.leader_identity = f"sim-leader-{self.instance_id}"
+        self.cache.binder = self.binder = _RecordingBinder(
+            self.injector.wrap_binder(self.cache.binder)
+        )
+        # Ingest without the background resync/cleanup loops: the
+        # sim drains those queues itself at deterministic points.
+        self.cache.start_ingest()
+        self.scheduler = Scheduler(
+            self.cache,
+            scheduler_conf=cfg.conf,
+            schedule_period=cfg.period,
+            clock=self.clock,
+        )
+        if self._device_kinds:
+            # Scheduler construction re-stamped the period-derived
+            # budget; restore the drill's small wall-clock one.
+            self._containment.configure(solve_budget=0.5)
+        if self._failover_enabled:
+            # Virtual-time lease: the drill's takeover waits out the
+            # real TTL on the virtual clock (renewed per cycle).
+            self.cluster.try_acquire_lease(
+                SIM_NAMESPACE, "leader", self.cache.leader_identity,
+                cfg.lease_duration, now=self.clock.now(),
+            )
+
+    def _failover(self, cycle: int, cut: str) -> dict:
+        """Process-death aftermath: finalize the dead instance, wait
+        out the (virtual) lease TTL, build the successor, and run the
+        production recovery pass — returning the trace's failover block
+        (wall-clock-free, so record and replay compare byte-equal)."""
+        dead_cache = self.cache
+        dead_endpoint = self.endpoint
+        dead_binder = self.binder
+        dead_identity = dead_cache.leader_identity
+        # The dead instance's side effects were already barriered by
+        # _run_cycle's step-4 kill branch (before the injector's seam
+        # drain); the landed-bind set is final here.
+        dead_endpoint.finalize_death()
+        dead_cache.shutdown()
+
+        # Lease takeover: a killed leader released nothing, so the
+        # successor must wait out the TTL in virtual time.
+        self.instance_id += 1
+        successor_id = f"sim-leader-{self.instance_id}"
+        takeover_wait = 0.0
+        if not self.cluster.try_acquire_lease(
+            SIM_NAMESPACE, "leader", successor_id,
+            self.cfg.lease_duration, now=self.clock.now(),
+        ):
+            takeover_wait = self.cfg.lease_duration + 1.0
+            self.clock.advance(takeover_wait)
+            if not self.cluster.try_acquire_lease(
+                SIM_NAMESPACE, "leader", successor_id,
+                self.cfg.lease_duration, now=self.clock.now(),
+            ):
+                raise RuntimeError(
+                    "failover: successor could not take the expired lease"
+                )
+
+        self._build_instance()
+        # Landed binds of the dead leader are this cycle's placements:
+        # carry them into the successor's recorder so the trace (and
+        # the replay verifier) sees them where they happened.
+        self.binder.records.extend(dead_binder.records)
+
+        # The production successor-recovery pass (cache/recovery.py via
+        # the Scheduler entry point): classify the dead leader's
+        # surviving intents, complete or evict partial gangs.
+        report = self.scheduler.recover_from_journal()
+        summary = report.summary() if report is not None else {}
+        if report is not None:
+            if report.errors:
+                self.report.recovery_failures += report.errors
+            for item in report.evicted:
+                job_key = item["job"]
+                self.checker.mark_degraded(job_key, cycle)
+                ns, _, job_name = job_key.partition("/")
+                pod_ns, _, pod_name = item["pod"].partition("/")
+                if (
+                    not self.replaying
+                    and self.cfg.recreate_killed
+                    and job_name in self._job_specs
+                ):
+                    self._schedule_recreation(job_name, pod_name, cycle)
+        # Wall-clock fields are forensics, not semantics: the trace's
+        # failover block must be bit-equal between record and replay.
+        summary.pop("duration_ms", None)
+        info = {
+            "cut": cut,
+            "cycle": cycle,
+            "killed": dead_identity,
+            "successor": successor_id,
+            "takeover_wait_s": round(takeover_wait, 3),
+            "binds_refused": dead_endpoint.binds_refused,
+            "marks_dropped": dead_endpoint.marks_dropped,
+            "recovery": summary,
+        }
+        self.report.leader_kills += 1
+        self.report.failovers.append(info)
+        return info
 
     # -- the cycle -----------------------------------------------------------
 
@@ -439,10 +593,18 @@ class ClusterSimulator:
             fault_events = self.injector.plan_cycle(
                 cycle, self._node_names(), self._running_pod_keys()
             )
+            planned_cut = cfg.kill_plan.get(cycle)
+            if planned_cut is not None and not any(
+                f["kind"] == "leader-kill" for f in fault_events
+            ):
+                fault_events.append(
+                    {"kind": "leader-kill", "cut": planned_cut}
+                )
 
         # 2. faults
         doomed: List[str] = []
         solver_fault = crash_fault = False
+        kill_cut: Optional[str] = None
         device_fault = None  # "exc" | "hang" for this cycle's solves
         for fault in fault_events:
             kind = fault["kind"]
@@ -473,16 +635,30 @@ class ClusterSimulator:
                 device_fault = "hang"
             elif kind == "backend-loss":
                 self.injector.note_backend_loss(cycle, fault["down_for"])
+            elif kind == "leader-kill":
+                kill_cut = fault["cut"]
 
         # 3. one real scheduling cycle. In micro mode only every Nth
         # cycle is periodic; the rest run the bounded warm-path micro
         # cycle (crash-fault cycles always run periodic so the injected
-        # crash action actually executes).
+        # crash action actually executes; a leader kill needs the full
+        # dispatch pipeline its cut points are defined against).
         micro_cycle = (
             cfg.micro_every > 1
             and cycle % cfg.micro_every != 0
             and not crash_fault
+            and kill_cut is None
         )
+        if self._failover_enabled and kill_cut is None:
+            # The live leader renews its lease each cycle; a killed
+            # leader deliberately does NOT — its last renewal is what
+            # the successor's takeover must wait out.
+            self.cluster.try_acquire_lease(
+                SIM_NAMESPACE, "leader", self.cache.leader_identity,
+                cfg.lease_duration, now=self.clock.now(),
+            )
+        if kill_cut is not None:
+            self.endpoint.arm_kill(kill_cut, cycle)
         self.injector.begin_cycle(
             cycle, doomed_nodes=doomed, solver_fault=device_fault
         )
@@ -517,8 +693,18 @@ class ClusterSimulator:
             # pays the same penalty.
             self.clock.advance(self.scheduler.cycle_error_backoff())
 
-        # 4. barrier + deterministic queue drains
-        self._settle()
+        # 4. barrier + deterministic queue drains. A killed leader's
+        # instance is only barriered on its in-flight (refusing) side
+        # effects — BEFORE end_cycle, so the bind seam's forensics are
+        # complete when drained; its resync/cleanup queues die with the
+        # process and the successor settles after recovery instead.
+        if kill_cut is not None:
+            if not self.cache.wait_for_side_effects(timeout=60.0):
+                logger.warning(
+                    "sim: dead leader side effects still in flight"
+                )
+        else:
+            self._settle()
         seam = self.injector.end_cycle()
         if cycle % 256 == 255:
             # Periodic deterministic GC of dead pods' bind-attempt
@@ -540,6 +726,15 @@ class ClusterSimulator:
                 self.report.fault_counts.get("bind", 0)
                 + seam["bind_faults"]
             )
+
+        # 4b. failover: the killed leader is torn down, the successor
+        # takes the lease, runs the production journal-recovery pass,
+        # and the world settles under the NEW instance before the
+        # invariant check judges the failover boundary.
+        failover_info = None
+        if kill_cut is not None:
+            failover_info = self._failover(cycle, kill_cut)
+            self._settle()
 
         # 5. post-cycle cleanup (orphans of mid-cycle node deaths)
         if self.replaying:
@@ -610,9 +805,16 @@ class ClusterSimulator:
             "stats": stats,
             "violations": violations,
         }
+        if failover_info is not None:
+            record["failover"] = failover_info
         self.writer.write(record)
         if self.replaying and rec is not None:
             if placements != rec.get("placements", []):
+                self.report.replay_mismatches.append(cycle)
+            elif failover_info != rec.get("failover"):
+                # The failover boundary is part of the replay contract:
+                # the successor must classify, re-drive and evict
+                # identically, or the drill is not deterministic.
                 self.report.replay_mismatches.append(cycle)
 
     def _finish_soak(self) -> None:

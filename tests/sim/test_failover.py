@@ -1,0 +1,196 @@
+"""Multi-scheduler failover kill drills (sim/failover.py +
+harness failover flow): at every cut point the successor takes the
+lease, recovers from the bind-intent journal, and the invariant
+checker holds across the boundary; the whole drill — including the
+recovery outcome — replays bit-identically."""
+
+import json
+
+import pytest
+
+from kube_batch_tpu.api.objects import GROUP_NAME_ANNOTATION_KEY
+from kube_batch_tpu.sim import SimConfig, TraceReader, WorkloadSpec
+from kube_batch_tpu.sim.failover import CUT_POINTS
+from kube_batch_tpu.sim.harness import ClusterSimulator, run_sim
+
+
+def drill_config(**kw):
+    kw.setdefault("workload", WorkloadSpec(nodes=10, arrival_rate=2.0))
+    kw.setdefault("backend", "native")
+    kw.setdefault("cycles", 16)
+    kw.setdefault("seed", 7)
+    return SimConfig(**kw)
+
+
+def assert_no_partial_gangs(cluster):
+    """Drill-end acceptance: no gang sits strictly between 0 bound
+    members and its minMember (cluster truth, first principles)."""
+    from kube_batch_tpu.api import PodPhase
+
+    min_member = {
+        f"{pg.namespace}/{pg.name}": pg.spec.min_member
+        for pg in cluster.list_objects("PodGroup")
+    }
+    bound = {}
+    for pod in cluster.list_objects("Pod"):
+        if not pod.spec.node_name or pod.status.phase in (
+            PodPhase.SUCCEEDED, PodPhase.FAILED
+        ):
+            continue
+        group = pod.metadata.annotations.get(GROUP_NAME_ANNOTATION_KEY)
+        if group:
+            key = f"{pod.namespace}/{group}"
+            bound[key] = bound.get(key, 0) + 1
+    for key, count in sorted(bound.items()):
+        minm = min_member.get(key, 0)
+        if minm > 1:
+            assert count >= minm, (
+                f"gang {key} left partial: {count} of {minm} bound"
+            )
+
+
+class TestKillDrill:
+    @pytest.mark.parametrize("cut", CUT_POINTS)
+    def test_each_cut_point_recovers_clean(self, cut):
+        sim = ClusterSimulator(drill_config(kill_plan={6: cut}))
+        report = sim.run()
+        assert report.violations == []
+        assert report.cycle_errors == 0
+        assert report.leader_kills == 1
+        assert report.recovery_failures == 0
+        fo = report.failovers[0]
+        assert fo["cut"] == cut
+        assert fo["cycle"] == 6
+        assert fo["killed"] == "sim-leader-0"
+        assert fo["successor"] == "sim-leader-1"
+        # The killed leader never released: the successor waited out
+        # the virtual lease TTL.
+        assert fo["takeover_wait_s"] > 0
+        # Nothing half-satisfied at drill end, and the journal holds no
+        # unreconciled predecessor intents.
+        assert_no_partial_gangs(sim.cluster)
+        assert sim.cluster.list_bind_intents() == []
+        lease = sim.cluster.read_lease("sim", "leader")
+        assert lease["holder"] == "sim-leader-1"
+
+    def test_cut_semantics_differ_as_designed(self):
+        """pre-solve dies before dispatch (no intents, no binds);
+        post-solve dies after the journal append (intents, no binds);
+        the successor classifies accordingly."""
+        pre = ClusterSimulator(drill_config(kill_plan={6: "pre-solve"}))
+        r_pre = pre.run()
+        post = ClusterSimulator(
+            drill_config(kill_plan={6: "post-solve-pre-drain"})
+        )
+        r_post = post.run()
+        assert r_pre.failovers[0]["recovery"].get(
+            "intents_scanned", 0
+        ) == 0
+        assert r_post.failovers[0]["recovery"]["intents_scanned"] >= 1
+        assert r_post.failovers[0]["recovery"]["outcomes"].get(
+            "requeued", 0
+        ) >= 1
+
+    def test_mid_bind_drain_repairs_gangs_by_redrive(self):
+        """Pinned seed whose kill cycles leave partial gangs: the
+        half-applied batches classify applied + lost, and recovery
+        completes the gangs on their journaled nodes."""
+        report, _ = run_sim(drill_config(
+            cycles=24, seed=5,
+            workload=WorkloadSpec(nodes=10, arrival_rate=3.0),
+            kill_plan={6: "mid-bind-drain", 14: "mid-bind-drain"},
+        ))
+        assert report.violations == []
+        assert report.leader_kills == 2
+        outcomes = {}
+        for fo in report.failovers:
+            for k, v in fo["recovery"].get("outcomes", {}).items():
+                outcomes[k] = outcomes.get(k, 0) + v
+        assert outcomes.get("applied", 0) >= 1   # landed subset
+        assert outcomes.get("redriven", 0) >= 1  # gang completed
+        assert report.failovers[0]["marks_dropped"] >= 1
+        # Repeated failovers: successor of the successor.
+        assert report.failovers[1]["killed"] == "sim-leader-1"
+        assert report.failovers[1]["successor"] == "sim-leader-2"
+
+    def test_probabilistic_leader_kill_fault_kind(self):
+        report, _ = run_sim(drill_config(
+            cycles=40, seed=11, faults="leader-kill:0.1,bind:0.03",
+        ))
+        assert report.fault_counts.get("leader-kill", 0) >= 1
+        assert report.leader_kills == report.fault_counts["leader-kill"]
+        assert report.violations == []
+        assert report.recovery_failures == 0
+
+    def test_scheduling_continues_after_failover(self):
+        """The successor is a fully working leader: placements keep
+        landing after the kill."""
+        report, trace = run_sim(drill_config(
+            cycles=20, kill_plan={6: "post-solve-pre-drain"},
+        ))
+        after = sum(
+            len(rec.get("placements", []))
+            for rec in trace
+            if rec.get("type") == "cycle" and rec["cycle"] > 6
+        )
+        assert after > 0
+        assert report.violations == []
+
+
+class TestDrillReplay:
+    def test_drill_replays_bit_identically(self, tmp_path):
+        trace_path = tmp_path / "drill.jsonl"
+        cfg = drill_config(
+            cycles=24, seed=13,
+            workload=WorkloadSpec(nodes=10, arrival_rate=3.0),
+            faults="bind:0.03",
+            kill_plan={
+                4: "pre-solve", 10: "post-solve-pre-drain",
+                16: "mid-bind-drain", 21: "mid-close",
+            },
+            trace_path=str(trace_path),
+        )
+        report, records = run_sim(cfg)
+        assert report.violations == []
+        assert report.leader_kills == 4
+        assert {f["cut"] for f in report.failovers} == set(CUT_POINTS)
+
+        replay_report, replay_records = run_sim(SimConfig(
+            replay=TraceReader.load(str(trace_path)),
+            backend="native",
+        ))
+        assert replay_report.replay_mismatches == []
+        assert replay_report.violations == []
+        # Byte-for-byte: every cycle record, INCLUDING the failover
+        # blocks (cut, takeover wait, recovery outcomes), is identical.
+        rec_cycles = [r for r in records if r.get("type") == "cycle"]
+        rep_cycles = [
+            r for r in replay_records if r.get("type") == "cycle"
+        ]
+        assert json.dumps(rec_cycles, sort_keys=True) == json.dumps(
+            rep_cycles, sort_keys=True
+        )
+        assert replay_report.leader_kills == 4
+
+    def test_failover_divergence_is_flagged(self, tmp_path):
+        """A tampered recovery outcome in the recording must read as
+        replay divergence — the failover block is part of the verified
+        contract, not decoration."""
+        trace_path = tmp_path / "drill.jsonl"
+        report, _ = run_sim(drill_config(
+            cycles=12, kill_plan={6: "post-solve-pre-drain"},
+            trace_path=str(trace_path),
+        ))
+        assert report.leader_kills == 1
+        lines = trace_path.read_text().splitlines()
+        out = []
+        for line in lines:
+            rec = json.loads(line)
+            if rec.get("failover"):
+                rec["failover"]["binds_refused"] += 1
+            out.append(json.dumps(rec, sort_keys=True))
+        trace_path.write_text("\n".join(out) + "\n")
+        replay_report, _ = run_sim(SimConfig(
+            replay=TraceReader.load(str(trace_path)), backend="native",
+        ))
+        assert 6 in replay_report.replay_mismatches

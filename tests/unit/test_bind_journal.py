@@ -1,0 +1,273 @@
+"""Bind-intent journal: the durable commit-dispatch seam
+(doc/design/robustness.md, failover section).
+
+Covers the cluster-side stores (InProcessCluster in-memory,
+KubeCluster Lease-annotation via FakeKube) and the cache wiring:
+intents appended BEFORE side effects, applied/failed marks as binds
+drain, self-pruning on full resolution, and the KBT_BIND_JOURNAL kill
+switch."""
+
+import threading
+
+import pytest
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api import PodPhase, build_resource_list
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.cluster import InProcessCluster
+from kube_batch_tpu.utils.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+
+def req(cpu="500m", mem="512Mi"):
+    return build_resource_list(cpu=cpu, memory=mem)
+
+
+def record_for(uids, node="n1", job="ns/pg1", minm=2, leader="L0"):
+    return {
+        "leader": leader,
+        "tasks": [
+            {"uid": u, "pod": f"ns/{u}", "node": node, "job": job}
+            for u in uids
+        ],
+        "gangs": {job: minm},
+    }
+
+
+class TestInProcessJournal:
+    def test_append_assigns_monotone_seqs_and_lists_sorted(self):
+        c = InProcessCluster(simulate_kubelet=False)
+        s1 = c.append_bind_intent(record_for(["a"]))
+        s2 = c.append_bind_intent(record_for(["b"]))
+        assert s2 > s1
+        recs = c.list_bind_intents()
+        assert [r["seq"] for r in recs] == [s1, s2]
+        assert recs[0]["marks"] == {}
+        assert recs[0]["tasks"][0]["uid"] == "a"
+
+    def test_partial_marks_keep_record_full_marks_self_prune(self):
+        c = InProcessCluster(simulate_kubelet=False)
+        seq = c.append_bind_intent(record_for(["a", "b"]))
+        assert c.mark_bind_intent(seq, "a", "applied") is False
+        recs = c.list_bind_intents()
+        assert recs[0]["marks"] == {"a": "applied"}
+        # Second (last) mark resolves the record: self-pruned.
+        assert c.mark_bind_intent(seq, "b", "failed") is True
+        assert c.list_bind_intents() == []
+        # Marking a pruned/unknown seq is a no-op, not an error.
+        assert c.mark_bind_intent(seq, "a", "applied") is False
+
+    def test_remove_and_listed_copies_are_isolated(self):
+        c = InProcessCluster(simulate_kubelet=False)
+        seq = c.append_bind_intent(record_for(["a"]))
+        listed = c.list_bind_intents()[0]
+        listed["marks"]["a"] = "applied"  # caller-side mutation
+        assert c.list_bind_intents()[0]["marks"] == {}
+        c.remove_bind_intent(seq)
+        assert c.list_bind_intents() == []
+
+
+class TestCacheJournalWiring:
+    def make(self, **env):
+        cluster = InProcessCluster(simulate_kubelet=True)
+        cluster.create_queue(build_queue("default", weight=1))
+        cluster.create_node(
+            build_node("n1", build_resource_list(
+                cpu="8", memory="16Gi", pods=110,
+            ))
+        )
+        cluster.create_pod_group(
+            build_pod_group("pg1", namespace="ns", min_member=2)
+        )
+        for name in ("p1", "p2"):
+            cluster.create_pod(build_pod(
+                "ns", name, "", PodPhase.PENDING, req(), group_name="pg1"
+            ))
+        cache = SchedulerCache(cluster=cluster)
+        cache.start_ingest()
+        return cluster, cache
+
+    def tasks_of(self, cache, job="ns/pg1"):
+        with cache.mutex:
+            return sorted(
+                (t.clone() for t in cache.jobs[job].tasks.values()),
+                key=lambda t: t.name,
+            )
+
+    def test_bind_batch_journals_then_marks_applied_and_self_prunes(self):
+        cluster, cache = self.make()
+        assert cache.journal_enabled
+        before = metrics.bind_journal_intents.get(("appended",))
+        tasks = self.tasks_of(cache)
+        for t in tasks:
+            t.node_name = "n1"
+        cache.bind_batch(tasks)
+        assert cache.wait_for_side_effects()
+        # Both binds landed and were marked: the record resolved away.
+        assert cluster.list_bind_intents() == []
+        assert metrics.bind_journal_intents.get(("appended",)) == before + 1
+        assert metrics.bind_journal_intents.get(("applied",)) >= 2
+        assert cluster.get_pod("ns", "p1").spec.node_name == "n1"
+        cache.shutdown()
+
+    def test_bind_failure_marks_failed_and_resolves(self):
+        cluster, cache = self.make()
+
+        class Boom:
+            def bind(self, pod, hostname):
+                raise RuntimeError("injected bind failure")
+
+        cache.binder = Boom()
+        tasks = self.tasks_of(cache)
+        for t in tasks:
+            t.node_name = "n1"
+        failed_before = metrics.bind_journal_intents.get(("failed",))
+        cache.bind_batch(tasks)
+        assert cache.wait_for_side_effects()
+        assert cluster.list_bind_intents() == []
+        assert (
+            metrics.bind_journal_intents.get(("failed",))
+            >= failed_before + 2
+        )
+        cache.shutdown()
+
+    def test_single_bind_path_journals_too(self):
+        cluster, cache = self.make()
+        task = self.tasks_of(cache)[0]
+        cache.bind(task, "n1")
+        assert cache.wait_for_side_effects()
+        assert cluster.list_bind_intents() == []
+        assert cluster.get_pod("ns", "p1").spec.node_name == "n1"
+        cache.shutdown()
+
+    def test_env_kill_switch_disables_journaling(self, monkeypatch):
+        monkeypatch.setenv("KBT_BIND_JOURNAL", "0")
+        cluster, cache = self.make()
+        assert not cache.journal_enabled
+        tasks = self.tasks_of(cache)
+        for t in tasks:
+            t.node_name = "n1"
+        cache.bind_batch(tasks)
+        assert cache.wait_for_side_effects()
+        assert cluster.list_bind_intents() == []
+        assert cluster.get_pod("ns", "p1").spec.node_name == "n1"
+        cache.shutdown()
+
+    def test_journal_append_failure_never_blocks_binds(self):
+        cluster, cache = self.make()
+
+        def boom(record):
+            raise RuntimeError("journal store down")
+
+        cluster.append_bind_intent = boom
+        tasks = self.tasks_of(cache)
+        for t in tasks:
+            t.node_name = "n1"
+        cache.bind_batch(tasks)
+        assert cache.wait_for_side_effects()
+        # Binds landed unjournaled (availability over recoverability).
+        assert cluster.get_pod("ns", "p1").spec.node_name == "n1"
+        cache.shutdown()
+
+    def test_gang_min_member_recorded_in_intent(self):
+        cluster, cache = self.make()
+        captured = {}
+        orig = cluster.append_bind_intent
+
+        def spy(record):
+            captured.update(record)
+            return orig(record)
+
+        cluster.append_bind_intent = spy
+        tasks = self.tasks_of(cache)
+        for t in tasks:
+            t.node_name = "n1"
+        cache.bind_batch(tasks)
+        assert cache.wait_for_side_effects()
+        assert captured["gangs"] == {"ns/pg1": 2}
+        assert captured["leader"] == cache.leader_identity
+        assert sorted(t["uid"] for t in captured["tasks"]) == sorted(
+            t.uid for t in tasks
+        )
+        cache.shutdown()
+
+
+class TestKubeLeaseJournal:
+    """Lease-annotation journal on the real-cluster adapter, served by
+    the in-memory FakeKube API server (Lease CRUD with optimistic
+    concurrency)."""
+
+    @pytest.fixture()
+    def kube(self):
+        from kube_batch_tpu.cluster.kube import KubeCluster, KubeConfig
+        from kube_batch_tpu.utils.fake_kube import FakeKube
+
+        server = FakeKube()
+        cluster = KubeCluster(KubeConfig(server.url), watch_kinds=())
+        cluster.journal_namespace = "kube-system"
+        try:
+            yield server, cluster
+        finally:
+            server.close()
+
+    def test_append_mark_list_remove_roundtrip(self, kube):
+        _server, cluster = kube
+        assert cluster.supports_bind_journal
+        s1 = cluster.append_bind_intent(record_for(["a", "b"]))
+        s2 = cluster.append_bind_intent(record_for(["c"], job="ns/pg2"))
+        assert s2 == s1 + 1
+        recs = cluster.list_bind_intents()
+        assert [r["seq"] for r in recs] == [s1, s2]
+        # Partial mark persists; full marks self-prune through the CAS.
+        assert cluster.mark_bind_intent(s1, "a", "applied") is False
+        assert cluster.list_bind_intents()[0]["marks"] == {"a": "applied"}
+        assert cluster.mark_bind_intent(s1, "b", "failed") is True
+        assert [r["seq"] for r in cluster.list_bind_intents()] == [s2]
+        cluster.remove_bind_intent(s2)
+        assert cluster.list_bind_intents() == []
+        # Seq survives pruning: the counter rides the same annotation.
+        assert cluster.append_bind_intent(record_for(["d"])) == s2 + 1
+
+    def test_journal_survives_adapter_restart(self, kube):
+        """The failover property: a SECOND adapter (the successor's
+        process) reads the first one's intents back."""
+        server, cluster = kube
+        seq = cluster.append_bind_intent(record_for(["a"]))
+
+        from kube_batch_tpu.cluster.kube import KubeCluster, KubeConfig
+
+        successor = KubeCluster(KubeConfig(server.url), watch_kinds=())
+        successor.journal_namespace = "kube-system"
+        recs = successor.list_bind_intents()
+        assert [r["seq"] for r in recs] == [seq]
+        assert recs[0]["tasks"][0]["uid"] == "a"
+
+
+class TestConcurrentJournal:
+    def test_concurrent_appends_and_marks_stay_consistent(self):
+        """The journal seam is called from the cache's side-effect pool
+        — concurrent appenders/markers must neither lose records nor
+        deadlock (cluster.store lock)."""
+        c = InProcessCluster(simulate_kubelet=False)
+        seqs = []
+        lock = threading.Lock()
+
+        def worker(i):
+            seq = c.append_bind_intent(record_for([f"t{i}"]))
+            with lock:
+                seqs.append(seq)
+            c.mark_bind_intent(seq, f"t{i}", "applied")
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(seqs)) == 16
+        assert c.list_bind_intents() == []  # all resolved

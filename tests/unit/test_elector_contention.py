@@ -1,0 +1,118 @@
+"""Multi-candidate leader-election contention (the PR 7 release-race
+fix, extended to N racing candidates): exactly one winner, exactly one
+successor on release, immediate takeover on fence — plus the
+InProcessCluster lease store the sim's failover drill hands over on."""
+
+import threading
+
+from kube_batch_tpu.cli.server import LeaderElector
+from kube_batch_tpu.cluster import InProcessCluster
+
+
+def make_candidates(tmp_path, n, **kw):
+    kw.setdefault("lease_duration", 5.0)
+    kw.setdefault("retry_period", 0.05)
+    return [
+        LeaderElector(str(tmp_path), identity=f"cand-{i}", **kw)
+        for i in range(n)
+    ]
+
+
+def race(candidates):
+    """All candidates try_acquire simultaneously; returns winners."""
+    barrier = threading.Barrier(len(candidates))
+    results = {}
+    lock = threading.Lock()
+
+    def attempt(el):
+        barrier.wait()
+        won = el.try_acquire()
+        with lock:
+            results[el.identity] = won
+
+    threads = [
+        threading.Thread(target=attempt, args=(el,)) for el in candidates
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [identity for identity, won in sorted(results.items()) if won]
+
+
+class TestElectorContention:
+    def test_exactly_one_winner_among_racing_candidates(self, tmp_path):
+        candidates = make_candidates(tmp_path, 8)
+        winners = race(candidates)
+        assert len(winners) == 1
+        # Every loser retrying while the lease is live still loses.
+        holder = winners[0]
+        for el in candidates:
+            if el.identity != holder:
+                assert el.try_acquire() is False
+
+    def test_release_hands_exactly_one_successor_the_lease(self, tmp_path):
+        candidates = make_candidates(tmp_path, 6)
+        winners = race(candidates)
+        winner = next(
+            el for el in candidates if el.identity == winners[0]
+        )
+        winner.release()
+        remaining = [el for el in candidates if el is not winner]
+        successors = race(remaining)
+        assert len(successors) == 1
+
+    def test_fence_lets_a_successor_acquire_immediately(self, tmp_path):
+        """The zombie-fencing contract: fence() releases the lease (and
+        drains any renewer), so a healthy candidate takes over WITHOUT
+        waiting out the lease duration."""
+        candidates = make_candidates(
+            tmp_path, 4, lease_duration=3600.0,
+        )
+        winners = race(candidates)
+        winner = next(
+            el for el in candidates if el.identity == winners[0]
+        )
+        winner.fence("test: watchdog tripped")
+        assert winner.is_leader is False
+        successors = race(
+            [el for el in candidates if el is not winner]
+        )
+        assert len(successors) == 1  # immediate — TTL is an hour
+
+    def test_fenced_winner_cannot_reacquire(self, tmp_path):
+        a, b = make_candidates(tmp_path, 2)
+        assert a.try_acquire()
+        a.fence("test")
+        # A fenced elector's stop event refuses re-acquisition for the
+        # dying identity (the PR 7 release-race contract).
+        assert a.try_acquire() is False
+        assert b.try_acquire() is True
+
+
+class TestInProcessLeaseStore:
+    """The KubeCluster try_acquire_lease analog the failover drill's
+    virtual-time takeover runs on."""
+
+    def test_cas_expiry_and_release(self):
+        c = InProcessCluster(simulate_kubelet=False)
+        assert c.try_acquire_lease("sim", "leader", "a", 15.0, now=100.0)
+        # Fresh lease: a contender loses; the holder renews.
+        assert not c.try_acquire_lease("sim", "leader", "b", 15.0, now=110.0)
+        assert c.try_acquire_lease("sim", "leader", "a", 15.0, now=110.0)
+        # Past the TTL from the LAST renewal: steal succeeds and the
+        # transition is counted.
+        assert not c.try_acquire_lease("sim", "leader", "b", 15.0, now=124.0)
+        assert c.try_acquire_lease("sim", "leader", "b", 15.0, now=126.0)
+        lease = c.read_lease("sim", "leader")
+        assert lease["holder"] == "b"
+        assert lease["transitions"] == 1
+        # Graceful release clears the holder: immediate takeover.
+        c.release_lease("sim", "leader", "b")
+        assert c.try_acquire_lease("sim", "leader", "c", 15.0, now=126.5)
+
+    def test_release_by_non_holder_is_a_noop(self):
+        c = InProcessCluster(simulate_kubelet=False)
+        assert c.try_acquire_lease("sim", "leader", "a", 15.0, now=0.0)
+        c.release_lease("sim", "leader", "zombie")
+        assert c.read_lease("sim", "leader")["holder"] == "a"

@@ -1,0 +1,321 @@
+"""Successor recovery reconciliation (cache/recovery.py): every row of
+the decision table, gang repair by re-drive and by eviction, journal
+pruning, metrics, and the Scheduler entry point."""
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api import PodPhase, build_resource_list
+from kube_batch_tpu.cache import SchedulerCache, recovery
+from kube_batch_tpu.cache.recovery import reconcile_journal
+from kube_batch_tpu.cluster import InProcessCluster
+from kube_batch_tpu.utils.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+
+def req(cpu="500m", mem="512Mi"):
+    return build_resource_list(cpu=cpu, memory=mem)
+
+
+def make_cluster(nodes=("n1", "n2"), node_cpu="8"):
+    c = InProcessCluster(simulate_kubelet=True)
+    c.create_queue(build_queue("default", weight=1))
+    for n in nodes:
+        c.create_node(build_node(
+            n, build_resource_list(cpu=node_cpu, memory="16Gi", pods=110)
+        ))
+    return c
+
+
+def add_gang(cluster, name, members, min_member, bound_on=None):
+    """Create a PodGroup + pods; ``bound_on`` maps pod index -> node
+    for members already bound (Running)."""
+    bound_on = bound_on or {}
+    cluster.create_pod_group(build_pod_group(
+        name, namespace="ns", min_member=min_member
+    ))
+    pods = []
+    for i in range(members):
+        pod = build_pod(
+            "ns", f"{name}-{i}", "", PodPhase.PENDING, req(),
+            group_name=name,
+        )
+        cluster.create_pod(pod)
+        if i in bound_on:
+            cluster.bind_pod(pod, bound_on[i])
+        pods.append(pod)
+    return pods
+
+
+def intent(cluster, pods, nodes, job, minm, marks=None, leader="dead-0"):
+    seq = cluster.append_bind_intent({
+        "leader": leader,
+        "tasks": [
+            {"uid": p.uid, "pod": f"ns/{p.name}", "node": n, "job": job}
+            for p, n in zip(pods, nodes)
+        ],
+        "gangs": {job: minm},
+    })
+    for uid, outcome in (marks or {}).items():
+        cluster.mark_bind_intent(seq, uid, outcome)
+    return seq
+
+
+class TestClassification:
+    def test_marked_and_truth_backfilled_rows(self):
+        c = make_cluster()
+        pods = add_gang(c, "pg1", 4, 2, bound_on={0: "n1", 1: "n1"})
+        # p0: bind landed + marked; p1: bind landed, mark lost in the
+        # crash; p2: bound ELSEWHERE by a later leader; p3: deleted.
+        c.bind_pod(pods[2], "n2")
+        intent(
+            c, pods, ["n1", "n1", "n1", "n1"], "ns/pg1", 2,
+            marks={pods[0].uid: "applied"},
+        )
+        c.delete_pod(pods[3])
+        report = reconcile_journal(c, "succ-1")
+        assert report.outcomes == {
+            "applied": 2, "superseded": 1, "vanished": 1,
+        }
+        assert report.errors == 0
+        # Every predecessor record pruned after classification.
+        assert c.list_bind_intents() == []
+
+    def test_failed_mark_classifies_failed(self):
+        # A FULLY-marked record self-prunes at mark time (nothing left
+        # for recovery), so the failed row only survives a crash in a
+        # partially-marked record.
+        c = make_cluster()
+        pods = add_gang(c, "pg1", 2, 1)
+        intent(c, pods, ["n1", "n1"], "ns/pg1", 1,
+               marks={pods[0].uid: "failed"})
+        report = reconcile_journal(c, "succ-1")
+        assert report.outcomes == {"failed": 1, "requeued": 1}
+
+    def test_lost_without_gang_constraint_requeues(self):
+        c = make_cluster()
+        pods = add_gang(c, "pg1", 2, 1)  # min_member 1: no atomicity
+        intent(c, pods, ["n1", "n1"], "ns/pg1", 1)
+        report = reconcile_journal(c, "succ-1")
+        assert report.outcomes == {"requeued": 2}
+        # Nothing was bound or deleted.
+        assert c.get_pod("ns", "pg1-0").spec.node_name == ""
+
+    def test_lost_whole_gang_unbound_requeues(self):
+        """bound == 0: no partial placement — normal scheduling owns
+        the gang; recovery must not re-drive it."""
+        c = make_cluster()
+        pods = add_gang(c, "pg1", 4, 4)
+        intent(c, pods, ["n1"] * 4, "ns/pg1", 4)
+        report = reconcile_journal(c, "succ-1")
+        assert report.outcomes == {"requeued": 4}
+        assert report.gangs_repaired == []
+        assert report.gangs_evicted == []
+
+
+class TestGangRepair:
+    def test_redrive_completes_partial_gang(self):
+        c = make_cluster()
+        pods = add_gang(c, "pg1", 4, 4, bound_on={0: "n1", 1: "n1"})
+        intent(c, pods, ["n1", "n1", "n2", "n2"], "ns/pg1", 4)
+        before = metrics.scheduler_failover_recoveries.get(("redriven",))
+        report = reconcile_journal(c, "succ-1")
+        assert report.outcomes == {"applied": 2, "redriven": 2}
+        assert report.gangs_repaired == ["ns/pg1"]
+        assert report.gangs_evicted == []
+        # The lost members now sit on their journaled nodes.
+        assert c.get_pod("ns", "pg1-2").spec.node_name == "n2"
+        assert c.get_pod("ns", "pg1-3").spec.node_name == "n2"
+        assert (
+            metrics.scheduler_failover_recoveries.get(("redriven",))
+            == before + 2
+        )
+        # The successor's own re-drive intent resolved (marks applied)
+        # and the predecessor record was pruned: journal empty.
+        assert c.list_bind_intents() == []
+        assert recovery.LAST_RECOVERY["outcomes"]["redriven"] == 2
+
+    def test_redrive_respects_capacity_recount(self):
+        """A journaled target that no longer fits must not be
+        oversubscribed — completion fails, the partial placement is
+        evicted instead."""
+        c = make_cluster(nodes=("n1", "tiny"), node_cpu="8")
+        # Overwrite tiny with a node that fits nothing further.
+        c.create_node(build_node(
+            "tiny", build_resource_list(cpu="500m", memory="1Gi", pods=2)
+        ))
+        filler = build_pod("ns", "filler", "", PodPhase.PENDING,
+                           req(cpu="400m"))
+        c.create_pod(filler)
+        c.bind_pod(filler, "tiny")
+        pods = add_gang(c, "pg1", 2, 2, bound_on={0: "n1"})
+        intent(c, pods, ["n1", "tiny"], "ns/pg1", 2)
+        report = reconcile_journal(c, "succ-1")
+        # p1 cannot fit on tiny -> gang cannot complete -> bound member
+        # p0 evicted; p1 stays pending (requeued).
+        assert report.outcomes == {"applied": 1, "evicted": 1,
+                                   "requeued": 1}
+        assert report.gangs_evicted == ["ns/pg1"]
+        assert c.get_pod("ns", "pg1-0") is None  # evicted
+        assert c.get_pod("ns", "pg1-1").spec.node_name == ""
+
+    def test_node_gone_evicts_partial_placement(self):
+        c = make_cluster(nodes=("n1",))
+        pods = add_gang(c, "pg1", 3, 3, bound_on={0: "n1"})
+        intent(c, pods, ["n1", "gone", "gone"], "ns/pg1", 3)
+        report = reconcile_journal(c, "succ-1")
+        assert report.outcomes == {"applied": 1, "evicted": 1,
+                                   "requeued": 2}
+        assert report.gangs_evicted == ["ns/pg1"]
+        assert [e["pod"] for e in report.evicted] == ["ns/pg1-0"]
+
+    def test_min_member_falls_back_to_journal_gangs(self):
+        """PodGroup died with the leader: the record's gangs entry is
+        the threshold of record."""
+        c = make_cluster()
+        pods = add_gang(c, "pg1", 3, 3, bound_on={0: "n1"})
+        for pg in c.list_objects("PodGroup"):
+            c.delete("PodGroup", pg)
+        intent(c, pods, ["n1", "n2", "n2"], "ns/pg1", 3)
+        report = reconcile_journal(c, "succ-1")
+        assert report.outcomes == {"applied": 1, "redriven": 2}
+        assert report.gangs_repaired == ["ns/pg1"]
+
+    def test_two_redrives_cannot_double_book_headroom(self):
+        """The capacity recount reserves as it plans: two lost tasks
+        whose journaled node only fits one must not both re-drive."""
+        c = make_cluster(nodes=("n1", "small"))
+        c.create_node(build_node(
+            "small", build_resource_list(cpu="700m", memory="2Gi", pods=8)
+        ))
+        pods = add_gang(c, "pg1", 3, 2, bound_on={0: "n1"})
+        intent(c, pods, ["n1", "small", "small"], "ns/pg1", 2)
+        report = reconcile_journal(c, "succ-1")
+        # One re-drive completes the gang (min 2); the other lost task
+        # requeues — and small is NOT oversubscribed.
+        assert report.outcomes == {"applied": 1, "redriven": 1,
+                                   "requeued": 1}
+        bound_small = [
+            p for p in c.list_objects("Pod")
+            if p.spec.node_name == "small"
+        ]
+        assert len(bound_small) == 1
+
+
+class TestCapacityLedger:
+    def test_abandoned_plan_reservations_roll_back(self):
+        """Gang A (sorted first) plans a re-drive onto the only node
+        but cannot reach minMember (its other member targets a gone
+        node) and is evicted; its abandoned reservation — and its
+        evicted member's usage — must be credited back so gang B, whose
+        repair needs that exact headroom, still re-drives instead of
+        being spuriously torn down."""
+        c = make_cluster(nodes=("solo",))
+        # solo fits ~3 pods of 500m alongside nothing else.
+        c.create_node(build_node(
+            "solo", build_resource_list(cpu="1500m", memory="4Gi", pods=8)
+        ))
+        a = add_gang(c, "aaa", 3, 3, bound_on={0: "solo"})
+        b = add_gang(c, "bbb", 2, 2, bound_on={0: "solo"})
+        intent(c, a, ["solo", "solo", "gone"], "ns/aaa", 3)
+        intent(c, b, ["solo", "solo"], "ns/bbb", 2)
+        report = reconcile_journal(c, "succ-1")
+        # A: applied 1 (bound), plan for a-1 abandoned (a-2's node is
+        # gone -> cannot reach 3) -> eviction of its bound member,
+        # requeue of the lost ones. B: applied 1 + redriven 1 -> whole.
+        assert report.gangs_evicted == ["ns/aaa"]
+        assert report.gangs_repaired == ["ns/bbb"]
+        assert c.get_pod("ns", "bbb-1").spec.node_name == "solo"
+        # solo holds exactly gang B (2 x 500m) at the end.
+        bound = sorted(
+            p.name for p in c.list_objects("Pod") if p.spec.node_name
+        )
+        assert bound == ["bbb-0", "bbb-1"]
+
+
+class TestRecoveryRobustness:
+    def test_journal_scan_failure_reports_error_not_raise(self):
+        c = make_cluster()
+
+        def boom():
+            raise RuntimeError("journal unreadable")
+
+        c.list_bind_intents = boom
+        report = reconcile_journal(c, "succ-1")
+        assert report.errors == 1
+        assert report.intents_scanned == 0
+
+    def test_malformed_record_does_not_abort_the_pass(self):
+        c = make_cluster()
+        pods = add_gang(c, "pg1", 1, 1, bound_on={0: "n1"})
+        c.append_bind_intent({"leader": "x"})  # no tasks at all
+        intent(c, pods, ["n1"], "ns/pg1", 1)  # unmarked, bound: applied
+        report = reconcile_journal(c, "succ-1")
+        assert report.outcomes == {"applied": 1}
+        assert c.list_bind_intents() == []
+
+
+class TestSchedulerEntryPoint:
+    def make_scheduler(self, cluster):
+        from kube_batch_tpu.scheduler import Scheduler
+
+        cache = SchedulerCache(cluster=cluster)
+        cache.leader_identity = "succ-sched"
+        cache.start_ingest()
+        return Scheduler(cache, schedule_period=0.01)
+
+    def test_recover_from_journal_runs_and_notes_flight_record(self):
+        c = make_cluster()
+        pods = add_gang(c, "pg1", 2, 2, bound_on={0: "n1"})
+        intent(c, pods, ["n1", "n2"], "ns/pg1", 2)
+        sched = self.make_scheduler(c)
+        report = sched.recover_from_journal()
+        assert report is not None
+        assert report.leader == "succ-sched"
+        assert report.outcomes == {"applied": 1, "redriven": 1}
+        # The first post-recovery cycle carries the summary.
+        assert sched._pending_recovery_note["outcomes"] == {
+            "applied": 1, "redriven": 1,
+        }
+        sched.cache.shutdown()
+
+    def test_kbt_recovery_0_skips(self, monkeypatch):
+        c = make_cluster()
+        pods = add_gang(c, "pg1", 2, 2, bound_on={0: "n1"})
+        intent(c, pods, ["n1", "n2"], "ns/pg1", 2)
+        monkeypatch.setenv("KBT_RECOVERY", "0")
+        sched = self.make_scheduler(c)
+        assert sched.recover_from_journal() is None
+        assert len(c.list_bind_intents()) == 1  # untouched
+        sched.cache.shutdown()
+
+    def test_no_journal_seam_is_a_noop(self):
+        from kube_batch_tpu.scheduler import Scheduler
+
+        cache = SchedulerCache()  # no cluster at all
+        sched = Scheduler(cache, schedule_period=0.01)
+        assert sched.recover_from_journal() is None
+
+
+class TestLeaseTTLSanity:
+    def test_short_lease_flags_and_exports(self):
+        import kube_batch_tpu.scheduler as sched_mod
+        from kube_batch_tpu.scheduler import Scheduler
+
+        s = Scheduler(SchedulerCache(), schedule_period=1.0)
+        assert s.watchdog_budget > 15.0  # default derivation
+        verdict = s.check_lease_ttl(15.0)
+        assert verdict["sane"] is False
+        assert sched_mod.LEASE_TTL_CHECK == verdict
+
+        ok = s.check_lease_ttl(s.watchdog_budget + 1.0)
+        assert ok["sane"] is True
+
+    def test_disabled_watchdog_is_always_sane(self, monkeypatch):
+        from kube_batch_tpu.scheduler import Scheduler
+
+        monkeypatch.setenv("KBT_WATCHDOG_BUDGET", "0")
+        s = Scheduler(SchedulerCache(), schedule_period=1.0)
+        assert s.check_lease_ttl(1.0)["sane"] is True

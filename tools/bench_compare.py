@@ -95,6 +95,15 @@ POLICY: List[Tuple[str, str, float, str]] = [
     ("sharded_vs_single.single_ms", "lower", 0.50, "single"),
     ("sharded_vs_single.flat_ms", "lower", 0.50, "single"),
     ("sharded_vs_single.two_level_ms", "lower", 0.50, "single"),
+    # Cold-takeover failover recovery (PR 13): single-shot successor
+    # costs at the headline shape — fresh-cache ingest, journal scan +
+    # reconcile (incl. gang re-drives/eviction), first post-recovery
+    # cycle. (`make failover-smoke` guards correctness; these rows
+    # guard the takeover-latency trend.)
+    ("recovery.ingest_ms", "lower", 0.35, "single"),
+    ("recovery.reconcile_ms", "lower", 0.35, "single"),
+    ("recovery.first_cycle_ms", "lower", 0.35, "single"),
+    ("recovery.takeover_ms", "lower", 0.35, "single"),
     ("vs_baseline", "higher", 0.25, "ratio"),
     ("pods_placed_per_sec", "higher", 0.25, "min3"),
     ("sim.cycles_per_sec", "higher", 0.35, "med"),
