@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke bench-compare verify kbtlint typecheck ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke latency-smoke bench-compare verify kbtlint typecheck ci image clean
 
 all: native
 
@@ -149,6 +149,17 @@ failover-smoke:
 		--replay /tmp/kbt_failover_smoke.jsonl --backend native \
 		--require-kill-cuts all --fail-on-cycle-errors --quiet
 
+# Placement-latency SLI smoke (doc/design/observability.md §5): a
+# short high-arrival burst run must (1) stamp pods at arrival and
+# carry them to bind-applied with a total-stage p99 present, (2) land
+# the placement_p99:<queue> / latency_entries series in the soak
+# telemetry dump (the series the drift/leak detectors watch), and
+# (3) emit a decision-audit JSONL that parses AND replays
+# byte-identical (virtual-clock stamping; wall clock never enters a
+# record). Exit 2/3/4 name the failing layer.
+latency-smoke:
+	env $(CPU_ENV) $(PY) tools/latency_smoke.py
+
 # Bench regression sentinel across the two newest committed bench
 # rounds (noise-aware: canary-normalized thresholds + the explicit
 # allowlist), THEN its own self-test: an injected 20% cycle_ms
@@ -203,7 +214,7 @@ typecheck:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke bench-compare
+ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke latency-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

@@ -828,6 +828,7 @@ class AllocateTpuAction(Action):
             idle = ctx.node_idle_host[nodes_sorted]
             eps = ctx.layout.eps().astype(np.float64)
             all_fit = bool((prefix + fit_rows < idle + eps).all())
+        placed_tasks: list = []
         if all_fit:
             if sel.size:
                 # Per-node groups straight from the fit guard's
@@ -883,6 +884,19 @@ class AllocateTpuAction(Action):
                 placed = ssn.allocate_batch_grouped(
                     node_groups, job_groups=job_groups
                 )
+                if placed == len(tasks_sorted):
+                    placed_tasks = tasks_sorted
+                else:
+                    # Staging dropped tasks (vanished node, volume
+                    # failure): only tasks whose status actually moved
+                    # count as placed — the ledger/audit must not
+                    # claim pods the apply path dropped.
+                    from ..api import allocated_status
+
+                    placed_tasks = [
+                        t for t in tasks_sorted
+                        if allocated_status(t.status)
+                    ]
             else:
                 placed = 0
         else:
@@ -903,6 +917,7 @@ class AllocateTpuAction(Action):
                 try:
                     ssn.allocate(task, node_name)
                     placed += 1
+                    placed_tasks.append(task)
                 except Exception:
                     logger.exception(
                         "Failed to bind Task %s on %s", task.uid, node_name
@@ -916,6 +931,51 @@ class AllocateTpuAction(Action):
 
         for k, v in last_apply_stats.items():
             last_stats[f"apply_{k}"] = v
+
+        # Placement-latency ledger + decision audit (obs/latency.py):
+        # stamp every task the solve placed (cycle kind, warm outcome,
+        # winning rung, this cycle's solve time) and append one audit
+        # record per placed job. Cost is O(placed) — zero on the idle
+        # cycle the <1% obs budget is pinned against. Deterministic
+        # fields only: the sim's audit stream must replay byte-equal.
+        cycle_kind = "micro" if micro else "periodic"
+        try:
+            from ..obs import latency as latency_mod
+
+            if placed_tasks and latency_mod.LEDGER.enabled:
+                placed_by_job: dict = {}
+                for task in placed_tasks:
+                    placed_by_job[task.job] = (
+                        placed_by_job.get(task.job, 0) + 1
+                    )
+                job_queues = {}
+                for job_uid in placed_by_job:
+                    job = ssn.jobs.get(job_uid)
+                    if job is not None:
+                        job_queues[job_uid] = job.queue
+                latency_mod.LEDGER.note_placed(
+                    ((task.uid, task.job) for task in placed_tasks),
+                    job_queues,
+                    kind=cycle_kind,
+                    solve_s=(
+                        last_stats.get("tensorize_ms", 0.0)
+                        + last_stats.get("solve_ms", 0.0)
+                        + last_stats.get("apply_ms", 0.0)
+                    ) / 1e3,
+                )
+                for job_uid, count in placed_by_job.items():
+                    latency_mod.AUDIT.append({
+                        "action": "placed",
+                        "job": job_uid,
+                        "queue": job_queues.get(job_uid, ""),
+                        "count": count,
+                        "kind": cycle_kind,
+                        "backend": backend,
+                        "warm": warm_outcome,
+                        "degraded": len(ladder) > 1 or breaker_pinned,
+                    })
+        except Exception:  # pragma: no cover - forensics only
+            logger.exception("placement-latency ledger update failed")
 
         t0 = time.perf_counter()
         # Epilogue: pipeline unassigned tasks onto Releasing resources

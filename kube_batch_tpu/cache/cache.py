@@ -660,13 +660,22 @@ class SchedulerCache(Cache, EventHandlersMixin):
         """Label-set GC: a removed job's per-job metric series
         (``unschedule_task_count`` / ``job_retry_counts``, keyed on the
         pod-group name the gang plugin labels with) must leave the
-        registry with it — an unbounded-cardinality leak otherwise."""
+        registry with it — an unbounded-cardinality leak otherwise.
+        The placement-latency ledger's per-pod entries GC on the same
+        hook (the PR 6 pattern: per-subject observability state dies
+        with the subject)."""
         try:
             from .. import metrics
 
             metrics.forget_job(job.name)
         except Exception:  # pragma: no cover - metrics must never kill
             logger.exception("job metric label GC failed")
+        try:
+            from ..obs.latency import LEDGER
+
+            LEDGER.forget_job(job.uid)
+        except Exception:  # pragma: no cover - forensics only
+            logger.exception("latency ledger job GC failed")
 
     # -- snapshot (reference cache.go:612-659) --------------------------------
 
@@ -1131,6 +1140,12 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 mark_sink[task_snapshot.uid] = "applied"
             else:
                 self._journal_mark(journal_seq, task_snapshot.uid, "applied")
+            # Placement-latency ledger: the applied stamp rides the
+            # journal-mark seam — the bind LANDED, so this timestamp is
+            # the truthful end of the pod's arrival→bind latency.
+            from ..obs.latency import LEDGER
+
+            LEDGER.note_applied(task_snapshot.uid)
             if self.cluster is not None:
                 self.cluster.record_event(
                     pod, "Normal", "Scheduled",
@@ -1148,6 +1163,11 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 mark_sink[task_snapshot.uid] = "failed"
             else:
                 self._journal_mark(journal_seq, task_snapshot.uid, "failed")
+            # Bind failure restarts the pod's latency clock (requeued
+            # stage): the next placement is measured from here.
+            from ..obs.latency import LEDGER
+
+            LEDGER.note_bind_failed(task_snapshot.uid)
             self._resync_task(task_snapshot)
 
     def bind(self, task_info: TaskInfo, hostname: str) -> None:
@@ -1166,6 +1186,9 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 # a real cluster an append is a blocking Lease CAS, and
                 # per-task dispatch paths call bind() in a loop); the
                 # append still strictly precedes the bind in this job.
+                from ..obs.latency import LEDGER
+
+                LEDGER.note_dispatched((task_snapshot.uid,))
                 seq = self._journal_append([task_snapshot])
                 self._bind_side_effect(
                     pod, hostname, task_snapshot, journal_seq=seq
@@ -1347,6 +1370,14 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self._journal_mark_many(
             journal_seq, {uid: "failed" for uid in failed_marks}
         )
+        # Placement-latency ledger (outside the mutex): staged binds
+        # are DISPATCHED; validation failures / node rejections restart
+        # their pods' clocks exactly like an async bind failure.
+        from ..obs.latency import LEDGER
+
+        LEDGER.note_dispatched([t.uid for t in bound])
+        for uid in failed_marks:
+            LEDGER.note_bind_failed(uid, reason="bind-rejected")
 
         # Pre-warm the COW snapshot pool for everything this batch
         # dirtied: re-clone the touched jobs/nodes HERE, on the
@@ -1428,6 +1459,13 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 self.cluster.record_event(
                     job.pod_group, "Normal", "Evict", reason
                 )
+        # Preempt/reclaim eviction restarts the victim's placement
+        # clock (requeued stage) — outside the mutex, leaf-lock ledger.
+        from ..obs.latency import LEDGER
+
+        LEDGER.note_requeued(
+            task_info.uid, reason="evicted", job=task_info.job
+        )
 
         def _do_evict():
             if self._refused_by_fence(

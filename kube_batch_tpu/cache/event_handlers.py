@@ -198,6 +198,18 @@ class EventHandlersMixin:
             and not pod.spec.node_name
         ):
             self._notify_arrival()
+            # Placement-latency ledger: stamp the arrival (outside the
+            # mutex — the ledger is its own leaf lock) so arrival→bind
+            # latency starts at the truthful moment the pod became
+            # schedulable work (obs/latency.py).
+            from ..api import get_job_id
+            from ..obs.latency import LEDGER
+
+            LEDGER.note_arrival(
+                pod.uid,
+                f"{pod.namespace}/{pod.name}",
+                get_job_id(pod) or pod.uid,
+            )
 
     def _stored_task(self, ti: TaskInfo) -> TaskInfo:
         """Resolve to the cache's own TaskInfo (handles Binding status drift,
@@ -271,6 +283,11 @@ class EventHandlersMixin:
                 pass
             if job is not None and job_terminated(job):
                 self._queue_job_cleanup(job)
+        # A deleted pod's latency entry dies with it (outside the
+        # mutex; the metrics-GC pattern — no per-pod ledger leak).
+        from ..obs.latency import LEDGER
+
+        LEDGER.forget_pod(pod.uid)
 
     # ---- nodes (reference event_handlers.go:264-366) -----------------------
 

@@ -23,6 +23,7 @@ from ..cache import new_scheduler_cache
 from ..cluster import ClusterAPI, InProcessCluster
 from ..obs import RECORDER, TELEMETRY
 from ..obs import explain as obs_explain
+from ..obs import latency as obs_latency
 from ..obs import telemetry as obs_telemetry
 from ..scheduler import Scheduler
 from ..version import RELEASE_VERSION
@@ -53,6 +54,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
       newest raw per-cycle samples (obs/telemetry.py);
     - ``/debug/flightrecorder``: the flight recorder's ring as
       canonical JSON (obs/flightrecorder.py);
+    - ``/debug/latency``: the placement-latency ledger snapshot —
+      per-queue/per-cycle-kind stage-decomposed percentiles, recent
+      applied entries, audit-ring meta (obs/latency.py);
     - ``/debug/jobs`` and ``/debug/jobs/<ns>/<name>``: per-job last
       unschedulable verdicts (obs/explain.py).
 
@@ -98,6 +102,17 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             )
         except Exception:  # pragma: no cover - probes must not 500
             logger.exception("/debug/vars watermark probe failed")
+        # Placement-latency SLI summary (obs/latency.py): stamped/
+        # applied counters, stage and per-queue p99s, audit-ring meta —
+        # one curl answers "are pods placing, and how fast". The full
+        # percentile tree lives at /debug/latency.
+        try:
+            out["latency"] = {
+                **obs_latency.LEDGER.summary(),
+                "audit": obs_latency.AUDIT.meta(),
+            }
+        except Exception:  # pragma: no cover - probes must not 500
+            logger.exception("/debug/vars latency probe failed")
         # Degraded-mode surface (doc/design/robustness.md): breaker
         # state machine + quarantine age, the last ladder descent, the
         # loop watchdog, and the leadership fence — one curl says
@@ -155,6 +170,13 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         elif path == "/debug/flightrecorder":
             self._reply(
                 RECORDER.dump_json(reason="http") + "\n",
+                ctype="application/json",
+            )
+        elif path == "/debug/latency":
+            payload = obs_latency.LEDGER.snapshot()
+            payload["audit"] = obs_latency.AUDIT.meta()
+            self._reply(
+                json.dumps(payload, sort_keys=True, default=repr) + "\n",
                 ctype="application/json",
             )
         elif path == "/debug/jobs":

@@ -23,13 +23,18 @@ observability.md):
 """
 
 from .flightrecorder import RECORDER, FlightRecorder, install_sigusr1
+from .latency import AUDIT, LEDGER, AuditLog, PlacementLedger
 from .telemetry import TELEMETRY, QuantileSketch, Telemetry
 from .tracer import TRACER, Tracer, export_trace, span, trace_dir_from_env
 
 __all__ = [
-    "RECORDER",
+    "AUDIT",
+    "AuditLog",
+    "LEDGER",
     "FlightRecorder",
+    "PlacementLedger",
     "QuantileSketch",
+    "RECORDER",
     "TELEMETRY",
     "TRACER",
     "Telemetry",

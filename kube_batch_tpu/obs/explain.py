@@ -29,6 +29,7 @@ Reason taxonomy (doc/design/observability.md carries the full table):
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -37,6 +38,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..utils.lockdebug import wrap_lock
+
+logger = logging.getLogger(__name__)
 
 REASON_PREDICATE = "predicate-blocked"
 REASON_QUEUE = "queue-overused"
@@ -236,6 +239,10 @@ def record_cycle_verdicts(ssn, ctx, assigned, sparse=None) -> Dict[str, int]:
         notes = dict(_VICTIM_NOTES)
         _VICTIM_NOTES.clear()
         resync_notes = {k: dict(v) for k, v in _RESYNC_NOTES.items()}
+    from . import latency as latency_mod
+
+    micro = bool(getattr(ssn, "micro_cycle", False))
+    cycle_kind = "micro" if micro else "periodic"
     new_verdicts: Dict[str, JobVerdict] = {}
     for uid, (rep, count) in per_job.items():
         job = ssn.jobs.get(uid)
@@ -271,6 +278,35 @@ def record_cycle_verdicts(ssn, ctx, assigned, sparse=None) -> Dict[str, int]:
             # Sticky: terminally-dropped tasks keep being named until
             # the job leaves the registry.
             detail["resync_terminal"] = dropped
+        # Placement-latency ledger: this cycle considered the job and
+        # left it unplaced — bump its queue-wait counter (tagged with
+        # the verdict reason) and carry "how long" in the detail so
+        # `explain <job>` / /debug/jobs answer how-long-and-why in one
+        # query. One decision-audit record per touched job rides along.
+        try:
+            wait = latency_mod.LEDGER.note_unplaced_job(
+                uid, reason, queue=job.queue
+            )
+            if wait is not None:
+                detail["cycles_waited"] = wait[0]
+                detail["waiting_since"] = wait[1]
+                detail["waiting_seconds"] = wait[2]
+            audit_rec = {
+                "action": "unassigned",
+                "job": uid,
+                "queue": job.queue,
+                "reason": reason,
+                "count": count,
+                "kind": cycle_kind,
+                "waited_cycles": wait[0] if wait is not None else None,
+            }
+            if note is not None:
+                audit_rec["victim_action"] = note["action"]
+                audit_rec["victims"] = note["victims"]
+                audit_rec["victim_placed"] = note["placed"]
+            latency_mod.AUDIT.append(audit_rec)
+        except Exception:  # pragma: no cover - forensics only
+            logger.exception("latency ledger verdict update failed")
         message = (
             f"{count} task(s) unassigned: {qualifier}; representative "
             f"task has {feasible} feasible node(s)"
@@ -451,6 +487,16 @@ def format_diagnosis(diag: dict) -> str:
             f"  last cycle verdict: {verdict['reason']} — "
             f"{verdict['message']}"
         )
+        detail = verdict.get("detail") or {}
+        if detail.get("cycles_waited") is not None:
+            lines.append(
+                f"  waiting {detail['cycles_waited']} solve cycle(s)"
+                + (
+                    f" ({detail['waiting_seconds']:.3f}s on the "
+                    f"scheduler clock)"
+                    if detail.get("waiting_seconds") is not None else ""
+                )
+            )
         vs = (verdict.get("detail") or {}).get("victim_selection")
         if vs:
             lines.append(

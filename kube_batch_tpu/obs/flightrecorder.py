@@ -223,6 +223,19 @@ class FlightRecorder:
                 )
         except Exception:  # pragma: no cover - dump must never fail
             logger.exception("telemetry embed in flight dump failed")
+        # Placement-latency context: the ledger's engagement summary
+        # (stage p99s, per-queue p99, requeue counters) + audit-ring
+        # meta ride along, so an error dump answers "were pods waiting,
+        # and how long" without a second endpoint scrape.
+        try:
+            from .latency import AUDIT, LEDGER
+
+            if LEDGER.enabled and LEDGER.stamped:
+                out["latency"] = _jsonable({
+                    **LEDGER.summary(), "audit": AUDIT.meta(),
+                })
+        except Exception:  # pragma: no cover - dump must never fail
+            logger.exception("latency embed in flight dump failed")
         return out
 
     def dump_json(self, reason: str = "on-demand") -> str:

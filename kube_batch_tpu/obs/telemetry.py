@@ -119,6 +119,21 @@ class QuantileSketch:
                 self.buckets.pop(lo[0]) + self.buckets.get(lo[1], 0)
             )
 
+    def merge(self, other: "QuantileSketch") -> None:
+        """Fold ``other`` into this sketch (log-bucket counts add
+        exactly — DDSketch mergeability). Lives HERE, next to the
+        fields it touches, so callers never poke sketch internals; the
+        same ``max_buckets`` coalescing as :meth:`add` applies."""
+        self.count += other.count
+        if other.low_count:
+            if self.low_count == 0 or other.low_min < self.low_min:
+                self.low_min = other.low_min
+            self.low_count += other.low_count
+        for idx, c in other.buckets.items():
+            self.buckets[idx] = self.buckets.get(idx, 0) + c
+        while len(self.buckets) > self.max_buckets:
+            self._collapse_lowest()
+
     def quantile(self, q: float) -> float:
         """Value estimate at quantile ``q`` in [0, 1]; 0.0 when empty."""
         if self.count == 0:
@@ -359,6 +374,17 @@ class Telemetry:
             cache=cache,
             expensive=self.cycles_observed % EXPENSIVE_EVERY == 0,
         ))
+        # Placement-latency series (obs/latency.py): ledger occupancy
+        # (the leak watermark) + per-queue p99 arrival→bind latency —
+        # the series the soak drift detector bounds so a slow
+        # scheduling-latency regression fails a soak instead of hiding.
+        try:
+            from .latency import LEDGER
+
+            if LEDGER.enabled:
+                values.update(LEDGER.telemetry_sample())
+        except Exception:  # pragma: no cover - probes must never kill
+            logger.exception("placement-latency telemetry probe failed")
         fairness_ran = False
         if cache is not None and self.cycles_observed % FAIRNESS_EVERY == 0:
             try:

@@ -280,6 +280,13 @@ class Scheduler:
         # KBT_TRACE_DIR arms the span tracer for the whole loop; the
         # trace file is written on loop exit and on cycle errors.
         maybe_enable_from_env()
+        # Placement-latency ledger clock: stamps ride the scheduler's
+        # injectable clock, so the simulator's ledger (and its audit
+        # stream) run on virtual time — replay-deterministic by
+        # construction (obs/latency.py).
+        from .obs.latency import LEDGER
+
+        LEDGER.configure(clock=self.clock.now)
         # Per-cycle telemetry feed (KBT_TELEMETRY=0 disables).
         from .obs.telemetry import telemetry_enabled_from_env
 
@@ -504,6 +511,9 @@ class Scheduler:
         self._cycle_count += 1
         TRACER.begin_cycle(cycle)
         RECORDER.begin_cycle(cycle, kind="micro")
+        from .obs.latency import LEDGER
+
+        LEDGER.begin_cycle(cycle, kind="micro")
         if self.watchdog is not None:
             self.watchdog.cycle_begin(cycle)
         cycle_start = time.perf_counter()
@@ -615,6 +625,9 @@ class Scheduler:
         self._cycle_count += 1
         TRACER.begin_cycle(cycle)
         RECORDER.begin_cycle(cycle)
+        from .obs.latency import LEDGER
+
+        LEDGER.begin_cycle(cycle, kind="periodic")
         if self._pending_recovery_note is not None:
             # First post-recovery cycle: the failover reconciliation's
             # outcome rides in this cycle's flight record, so an error

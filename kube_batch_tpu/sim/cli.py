@@ -56,6 +56,21 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--arrival-rate", type=float, default=1.5,
                         help="expected job arrivals per cycle")
     parser.add_argument(
+        "--arrival-profile", choices=("poisson", "sustained", "burst"),
+        default="poisson",
+        help="arrival shape: seeded Poisson draws (default), a flat "
+             "sustained firehose of round(rate) jobs every cycle, or "
+             "Poisson plus a burst spike every --burst-every cycles")
+    parser.add_argument("--burst-every", type=int, default=16,
+                        help="cycles between burst spikes "
+                             "(--arrival-profile burst)")
+    parser.add_argument("--burst-size", type=int, default=64,
+                        help="jobs per burst spike "
+                             "(--arrival-profile burst)")
+    parser.add_argument(
+        "--max-jobs-in-flight", type=int, default=64,
+        help="arrival back-pressure bound (jobs alive at once)")
+    parser.add_argument(
         "--node-churn", type=float, default=0.0,
         help="per-cycle probability of a planned node add AND drain")
     parser.add_argument(
@@ -91,6 +106,12 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
         "--telemetry-out", default=None, metavar="PATH",
         help="with --soak: write the telemetry windows + detector "
              "verdict JSON here (default: <trace>.telemetry.json)")
+    parser.add_argument(
+        "--audit-out", default=None, metavar="PATH",
+        help="write the placement decision-audit stream (canonical "
+             "JSONL, virtual-clock-stamped — byte-identical under "
+             "--replay) here; default: <trace>.audit.jsonl when "
+             "--trace is set")
     parser.add_argument("--no-check", dest="check", action="store_false",
                         default=True, help="skip the invariant checker")
     parser.add_argument("--fail-on-cycle-errors", action="store_true",
@@ -149,6 +170,10 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         node_mem_mi=ns.node_mem_mi,
         queues=queues or {"default": 1},
         arrival_rate=ns.arrival_rate,
+        arrival_profile=ns.arrival_profile,
+        burst_every=ns.burst_every,
+        burst_size=ns.burst_size,
+        max_jobs_in_flight=ns.max_jobs_in_flight,
         node_add_rate=ns.node_churn,
         node_drain_rate=ns.node_churn,
     )
@@ -172,6 +197,7 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         check_invariants=ns.check,
         soak=ns.soak,
         telemetry_out=ns.telemetry_out,
+        audit_out=ns.audit_out,
     )
 
 

@@ -110,6 +110,12 @@ class SimConfig:
     kill_plan: Dict[int, str] = field(default_factory=dict)
     # Virtual-time lease TTL for the drill's takeover wait.
     lease_duration: float = 15.0
+    # Decision-audit dump (--audit-out): the placement ledger's audit
+    # stream as canonical JSONL — virtual-clock-stamped, so a replay's
+    # dump is byte-identical to the recording's (make latency-smoke
+    # pins this). Defaults to <trace>.audit.jsonl when a trace is
+    # recorded.
+    audit_out: Optional[str] = None
 
 
 @dataclass
@@ -142,6 +148,11 @@ class SimReport:
     leader_kills: int = 0
     failovers: List[dict] = field(default_factory=list)
     recovery_failures: int = 0
+    # Placement-latency ledger engagement summary (obs/latency.py) and
+    # the decision-audit dump written alongside the trace.
+    latency: Optional[dict] = None
+    audit_records: int = 0
+    audit_path: Optional[str] = None
 
     @property
     def cycles_per_sec(self) -> float:
@@ -173,6 +184,11 @@ class SimReport:
                 "failovers": list(self.failovers),
                 "recovery_failures": self.recovery_failures,
             } if self.leader_kills else {}),
+            **({
+                "latency": self.latency,
+                "audit_records": self.audit_records,
+                "audit_path": self.audit_path,
+            } if self.latency is not None else {}),
         }
 
 
@@ -247,6 +263,15 @@ class ClusterSimulator:
 
         self._containment = _containment
         _containment.reset_breaker()
+        # Placement-latency ledger + decision audit are process-global
+        # (like the breaker): a run must start them empty, or a second
+        # sim in the same process inherits the first's entries and its
+        # replay can never be byte-identical. The scheduler built in
+        # _build_instance installs the virtual clock.
+        from ..obs.latency import AUDIT, LEDGER
+
+        LEDGER.reset()
+        AUDIT.reset()
         # Failover drill state: device-kind memo (successor instances
         # must re-stamp the 0.5 s solve budget their Scheduler
         # construction resets) and the kill switchboard.
@@ -406,6 +431,7 @@ class ClusterSimulator:
                 self.clock.advance(cfg.period)
             self.report.cycles = cfg.cycles
             self.report.breaker = self._containment.BREAKER.state_dict()
+            self._finish_latency()
             if cfg.soak:
                 self._finish_soak()
         finally:
@@ -816,6 +842,27 @@ class ClusterSimulator:
                 # the successor must classify, re-drive and evict
                 # identically, or the drill is not deterministic.
                 self.report.replay_mismatches.append(cycle)
+
+    def _finish_latency(self) -> None:
+        """End of run: land the placement ledger's engagement summary
+        in the report and dump the decision-audit stream (JSONL,
+        virtual-clock-stamped → byte-identical under replay) alongside
+        the trace or to --audit-out."""
+        from ..obs.latency import AUDIT, LEDGER
+
+        if not LEDGER.enabled:
+            return
+        self.report.latency = LEDGER.summary()
+        self.report.audit_records = AUDIT.meta()["records"]
+        path = self.cfg.audit_out or (
+            f"{self.cfg.trace_path}.audit.jsonl"
+            if self.cfg.trace_path else None
+        )
+        if path:
+            try:
+                self.report.audit_path = AUDIT.dump_jsonl(path)
+            except OSError:
+                logger.exception("sim audit dump failed")
 
     def _finish_soak(self) -> None:
         """End of a soak run: close the tail window, fit the leak/drift

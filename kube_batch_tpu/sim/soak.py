@@ -79,10 +79,21 @@ GROWTH_POLICY: Dict[str, GrowthPolicy] = {
     ),
     "metrics_series": GrowthPolicy(abs_floor=64, rel_floor=0.10),
     "explain_verdicts": GrowthPolicy(abs_floor=256, rel_floor=0.50),
+    # Placement-ledger occupancy (obs/latency.py): entries must die
+    # with their pods/jobs — sustained linear growth here is a per-pod
+    # ledger leak, exactly the class the metrics-GC pattern forbids.
+    "latency_entries": GrowthPolicy(abs_floor=512, rel_floor=0.50),
 }
 
 DRIFT_POLICY: Dict[str, DriftPolicy] = {
     "fairness_drift:": DriftPolicy(bound=0.35, patience=3, signed=False),
+    # Per-queue p99 arrival→bind placement latency (virtual seconds,
+    # obs/latency.py): a slow scheduling-latency regression must fail
+    # a soak instead of hiding — same trip semantics as fairness
+    # drift. The bound is generous (2 virtual minutes): saturation
+    # waves legitimately push p99 to many cycles; a systematic climb
+    # past the bound for `patience` windows is a scheduler regression.
+    "placement_p99:": DriftPolicy(bound=120.0, patience=3, signed=False),
     # Zero-bound series are hard invariants, not steady-state
     # properties — a cycle error in the first quarter of the run is as
     # fatal as one at the end, so they opt out of the warmup skip.

@@ -36,6 +36,16 @@ class WorkloadSpec:
         (500, 512, 0.6), (1000, 1024, 0.3), (2000, 2048, 0.1)
     )
     arrival_rate: float = 1.5       # expected job arrivals per cycle
+    # Arrival profile (the high-arrival SLI mixes, obs/latency.py):
+    # - "poisson":   seeded Poisson draws at arrival_rate (default);
+    # - "sustained": exactly round(arrival_rate) jobs EVERY cycle — a
+    #   flat firehose with no draw jitter (the 10k+ arrivals/s-
+    #   equivalent sustained mix is this with a large rate);
+    # - "burst":     Poisson base rate plus a spike of burst_size jobs
+    #   every burst_every cycles (thundering-herd arrival waves).
+    arrival_profile: str = "poisson"
+    burst_every: int = 16           # cycles between burst spikes
+    burst_size: int = 64            # jobs per burst spike
     duration_cycles: Tuple[int, int] = (4, 16)  # fully-running lifetime
     max_jobs_in_flight: int = 64    # arrival back-pressure bound
     # Planned churn: per-cycle probability of one node-add / node-drain
@@ -55,6 +65,9 @@ class WorkloadSpec:
             "gang_sizes": [list(g) for g in self.gang_sizes],
             "reqs": [list(r) for r in self.reqs],
             "arrival_rate": self.arrival_rate,
+            "arrival_profile": self.arrival_profile,
+            "burst_every": self.burst_every,
+            "burst_size": self.burst_size,
             "duration_cycles": list(self.duration_cycles),
             "max_jobs_in_flight": self.max_jobs_in_flight,
             "node_add_rate": self.node_add_rate,
@@ -176,8 +189,18 @@ class WorkloadGenerator:
                 {"kind": "node-remove", "name": victim, "reason": "drain"}
             )
 
-        # Arrivals.
-        arrivals = _poisson(rng, spec.arrival_rate)
+        # Arrivals (profile-shaped; every random draw stays on the one
+        # seeded stream so (seed, spec) still pins the event sequence).
+        if spec.arrival_profile == "sustained":
+            arrivals = max(0, int(round(spec.arrival_rate)))
+        else:
+            arrivals = _poisson(rng, spec.arrival_rate)
+            if (
+                spec.arrival_profile == "burst"
+                and spec.burst_every > 0
+                and cycle % spec.burst_every == 0
+            ):
+                arrivals += max(0, int(spec.burst_size))
         for _ in range(arrivals):
             if len(self.alive) - len(self._pending_delete) >= (
                 spec.max_jobs_in_flight

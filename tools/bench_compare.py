@@ -80,6 +80,16 @@ POLICY: List[Tuple[str, str, float, str]] = [
     # them OUT of the canary normalization.
     ("obs.tracer_overhead_pct", "lower", 10.0, "ratio"),
     ("obs.telemetry_overhead_pct", "lower", 10.0, "ratio"),
+    ("obs.latency_overhead_pct", "lower", 10.0, "ratio"),
+    # Placement-latency SLI mixes (PR 14): VIRTUAL-time p99s off the
+    # seeded deterministic sim — machine-independent (ratio kind, no
+    # canary), so a climb is a scheduling-delay regression by
+    # construction. The burst mix's applied count may never drop (the
+    # ledger must keep engaging end-to-end).
+    ("arrival_latency.sustained_0p1.total_p99_s", "lower", 0.25, "ratio"),
+    ("arrival_latency.sustained_1p.total_p99_s", "lower", 0.25, "ratio"),
+    ("arrival_latency.burst.total_p99_s", "lower", 0.25, "ratio"),
+    ("arrival_latency.burst.applied", "count", 0.0, "exact"),
     ("sim.invariant_check_ms_per_cycle", "lower", 0.50, "med"),
     ("sparse_scale.solve_ms", "lower", 0.35, "single"),
     # 1M x 100k headline point (PR 12): single-shot select+solve on a
