@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke latency-smoke bench-compare verify kbtlint typecheck ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke latency-smoke diverge-smoke bench-compare verify kbtlint typecheck ci image clean
 
 all: native
 
@@ -149,6 +149,27 @@ failover-smoke:
 		--replay /tmp/kbt_failover_smoke.jsonl --backend native \
 		--require-kill-cuts all --fail-on-cycle-errors --quiet
 
+# Cluster-truth anti-entropy smoke (doc/design/robustness.md, event-
+# stream hardening): a 300-cycle storm over the whole event-fault
+# grammar — dropped/duplicated/reordered/stale watch events, injected
+# relist failures, and corrupted solver results — with the ingest
+# guards, gap-repair relist, per-cycle anti-entropy sweep, and
+# post-solve validation all armed. Exit 1 on any invariant violation,
+# 3 on any cycle error, 7 if any divergence was left unrepaired at run
+# end (or no event fault actually fired — a vacuous storm proves
+# nothing); then the trace REPLAYS and placements must match
+# byte-for-byte (exit 2 on divergence).
+diverge-smoke:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim \
+		--cycles 300 --seed 15 --backend dense \
+		--faults "event-drop:0.06,event-dup:0.06,event-reorder:0.05,event-stale:0.05,relist-fail:0.25,solver-corrupt:0.04,bind:0.03" \
+		--node-churn 0.02 --antientropy-every 1 \
+		--trace /tmp/kbt_diverge_smoke.jsonl \
+		--require-divergence-repaired --fail-on-cycle-errors --quiet
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim \
+		--replay /tmp/kbt_diverge_smoke.jsonl --backend dense \
+		--require-divergence-repaired --fail-on-cycle-errors --quiet
+
 # Placement-latency SLI smoke (doc/design/observability.md §5): a
 # short high-arrival burst run must (1) stamp pods at arrival and
 # carry them to bind-applied with a total-stage p99 present, (2) land
@@ -214,7 +235,7 @@ typecheck:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke latency-smoke bench-compare
+ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke diverge-smoke latency-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

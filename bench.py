@@ -1139,6 +1139,166 @@ def bench_sharded_vs_single(tasks=65536, nodes=4096, devices=4):
     }
 
 
+def bench_integrity(cfg="large", seed=0):
+    """Cluster-truth anti-entropy + post-solve validation cost at the
+    headline shape (doc/design/robustness.md, event-stream hardening):
+
+    - ``sweep_cold_ms``: first sweep (builds the per-object digest
+      caches);
+    - ``sweep_steady_ms``: median consistent-mirror sweep — the cost a
+      production cycle amortizes over KBT_ANTIENTROPY_EVERY;
+    - ``sweep_divergent_ms``: sweep over a 1%-divergent mirror (watch
+      detached, 1% of pods bound + a slice deleted behind the cache's
+      back), with detected/repaired counts asserted;
+    - ``validation_ms``: post-solve validation of a full placement
+      vector (O(placements) mask + capacity recheck), plus the
+      tampered-vector rejection cost and ``validation_pct_of_steady``
+      vs the steady cycle — the <1% budget the tracer overhead is also
+      pinned against.
+    """
+    from kube_batch_tpu.cluster import InProcessCluster
+    from kube_batch_tpu.solver.validate import validate_placements
+
+    n_tasks, n_nodes, n_queues, n_groups = CONFIGS[cfg]
+    rng = np.random.RandomState(seed)
+    cluster = InProcessCluster(simulate_kubelet=False)
+    cache = SchedulerCache(
+        cluster=cluster,
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+    for q in range(n_queues):
+        cluster.create_queue(build_queue(f"q{q}", weight=q + 1))
+    for j in range(n_nodes):
+        cluster.create_node(build_node(
+            f"n{j}", build_resource_list(cpu="32", memory="128Gi", pods=110)
+        ))
+    per_group = n_tasks // n_groups
+    cpus = rng.choice([250, 500, 1000, 2000, 4000], size=n_tasks)
+    mems = rng.choice([256, 512, 1024, 4096, 8192], size=n_tasks)
+    t = 0
+    pods = []
+    for g in range(n_groups):
+        cluster.create_pod_group(build_pod_group(
+            f"pg{g}", namespace="bench",
+            min_member=int(rng.randint(1, per_group + 1)),
+            queue=f"q{g % n_queues}",
+        ))
+        for i in range(per_group):
+            pod = build_pod(
+                "bench", f"pg{g}-p{i}", "", PodPhase.PENDING,
+                build_resource_list(
+                    cpu=f"{int(cpus[t])}m", memory=f"{int(mems[t])}Mi"
+                ),
+                group_name=f"pg{g}",
+            )
+            cluster.create_pod(pod)
+            pods.append(pod)
+            t += 1
+    cache.start_ingest()
+
+    ae = cache.antientropy
+    t0 = time.perf_counter()
+    ae.sweep()
+    sweep_cold_ms = (time.perf_counter() - t0) * 1e3
+    steady = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        rep = ae.sweep()
+        steady.append((time.perf_counter() - t0) * 1e3)
+    assert not rep["detected"], rep
+    sweep_steady_ms = sorted(steady)[1]
+    # Churned variant: one benign cluster write moves the event rv, so
+    # the sweep pays the full truth listing + O(pods) witness loop —
+    # what a real 1%-churn steady state pays every
+    # KBT_ANTIENTROPY_EVERY cycles (the rv-unchanged shortcut above is
+    # the idle-cluster case).
+    churned = []
+    for _ in range(3):
+        cluster.update("Pod", pods[0])
+        t0 = time.perf_counter()
+        rep = ae.sweep()
+        churned.append((time.perf_counter() - t0) * 1e3)
+    assert not rep["detected"], rep
+    sweep_churned_ms = sorted(churned)[1]
+
+    # 1% divergence injected behind the cache's back: the watch is
+    # detached, a slice of pods is bound (missed-bind) and a smaller
+    # slice deleted (phantom-task), then the sweep must find + repair
+    # every one of them through the stamping handlers.
+    cluster.remove_watch(cache._on_watch_event)
+    n_div = max(2, n_tasks // 100)
+    picks = rng.choice(len(pods), size=n_div, replace=False)
+    for k, idx in enumerate(picks):
+        pod = pods[int(idx)]
+        if k % 8 == 0:
+            cluster.delete_pod(pod)
+        else:
+            try:
+                cluster.bind_pod(pod, f"n{int(idx) % n_nodes}")
+            except ValueError:
+                pass  # already bound by an earlier pick
+    cluster.add_watch(cache._on_watch_event)
+    t0 = time.perf_counter()
+    div = ae.sweep(budget=None)
+    sweep_divergent_ms = (time.perf_counter() - t0) * 1e3
+    detected = sum(div["detected"].values())
+    repaired = sum(div["repaired"].values())
+
+    # Post-solve validation cost on a FULL placement vector.
+    ssn = open_session(cache, make_tiers(*TIERS_ARGS))
+    try:
+        inputs, ctx = tensorize(ssn, device=False)
+        T, N = len(ctx.tasks), len(ctx.nodes)
+        a = (np.arange(T) % N).astype(np.int64)
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            bad, reasons = validate_placements(ctx, a)
+            times.append((time.perf_counter() - t0) * 1e3)
+        validation_ms = sorted(times)[2]
+        # Steady-churn-sized vector (1% of tasks placed — what a warm
+        # steady cycle actually proposes): the per-STEADY-cycle
+        # validation cost the <1% pin is quoted against; the full
+        # vector above is the cold-burst worst case.
+        a_steady = np.full(T, -1, dtype=np.int64)
+        n_churn = max(1, T // 100)
+        a_steady[:n_churn] = a[:n_churn]
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            validate_placements(ctx, a_steady)
+            times.append((time.perf_counter() - t0) * 1e3)
+        validation_steady_ms = sorted(times)[2]
+        tampered = a.copy()
+        tampered[: min(16, T)] = 2**30
+        t0 = time.perf_counter()
+        bad_t, reasons_t = validate_placements(ctx, tampered)
+        validation_reject_ms = (time.perf_counter() - t0) * 1e3
+        assert reasons_t.get("bad-index", 0) >= 1, reasons_t
+    finally:
+        close_session(ssn)
+    cache.shutdown()
+
+    return {
+        "config": cfg,
+        "pods": n_tasks,
+        "nodes": n_nodes,
+        "sweep_cold_ms": round(sweep_cold_ms, 2),
+        "sweep_steady_ms": round(sweep_steady_ms, 2),
+        "sweep_churned_ms": round(sweep_churned_ms, 2),
+        "sweep_divergent_ms": round(sweep_divergent_ms, 2),
+        "divergence_injected": int(n_div),
+        "divergence_detected": int(detected),
+        "divergence_repaired": int(repaired),
+        "validation_ms": round(validation_ms, 3),
+        "validation_steady_ms": round(validation_steady_ms, 3),
+        "validation_reject_ms": round(validation_reject_ms, 3),
+    }
+
+
 def bench_sim(cycles=80, seed=11):
     """Deterministic-simulator throughput: seeded fault run through the
     full production cycle (virtual clock, so the measured time is pure
@@ -1589,6 +1749,35 @@ def main():
     except Exception as exc:  # pragma: no cover - defensive
         arrival_latency = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # Anti-entropy sweep + post-solve validation cost at the headline
+    # shape, with the steady-cycle-relative budgets the <1% pin is
+    # quoted against (guarded like every section).
+    try:
+        integrity = bench_integrity(headline_cfg)
+        steady_ms = None
+        if isinstance(cycle, dict):
+            sw = cycle.get("steady_warm") or cycle.get("steady") or {}
+            steady_ms = sw.get("cycle_ms")
+        if steady_ms:
+            integrity["validation_pct_of_steady"] = round(
+                100.0 * integrity["validation_steady_ms"] / steady_ms, 3
+            )
+            every = int(os.environ.get("KBT_ANTIENTROPY_EVERY", "256"))
+            integrity["sweep_every"] = every
+            # Amortized off the CHURNED sweep — the honest steady-state
+            # cost (churn moves the cluster rv every cycle, so the
+            # idle-cluster shortcut never fires there).
+            integrity["sweep_amortized_pct_of_steady"] = round(
+                100.0 * (integrity["sweep_churned_ms"] / every)
+                / steady_ms, 3,
+            )
+            integrity["integrity_pct_of_steady"] = round(
+                integrity["sweep_amortized_pct_of_steady"]
+                + integrity["validation_pct_of_steady"], 3,
+            )
+    except Exception as exc:  # pragma: no cover - defensive
+        integrity = {"error": f"{type(exc).__name__}: {exc}"}
+
     dev0 = jax.devices()[0]
     provenance = {
         "platform": str(dev0.platform),
@@ -1619,6 +1808,7 @@ def main():
         "sim": sim,
         "recovery": recovery,
         "arrival_latency": arrival_latency,
+        "integrity": integrity,
         **({"sparse_scale": sparse_scale} if sparse_scale else {}),
         **({"sparse_scale_xl": sparse_scale_xl} if sparse_scale_xl
            else {}),

@@ -385,6 +385,7 @@ class AllocateTpuAction(Action):
 
         ``ladder`` accumulates one record per attempt — the flight
         record / verdict / bench attribution of which rungs ran."""
+        from ..solver import containment as _containment
         from ..solver.containment import (
             BREAKER,
             SolveFailed,
@@ -392,6 +393,7 @@ class AllocateTpuAction(Action):
             note_fallback,
             strip_candidates,
         )
+        from ..solver.validate import validate_placements
 
         idx = 0
         cur_inputs = inputs
@@ -433,6 +435,67 @@ class AllocateTpuAction(Action):
                 if nxt == "dense":
                     cur_inputs = strip_candidates(cur_inputs)
                 continue
+            # --- post-solve placement validation ----------------------
+            # The last gate before the result can reach bind dispatch:
+            # recheck every proposed placement against the feasibility
+            # mask + a capacity recount, O(placements) host-side. A
+            # device rung is additionally exposed to the sim's
+            # solver-corrupt tamper seam here — exactly where a silent
+            # device miscompute would land.
+            if rung != "native":
+                assigned = _containment.apply_result_tamper(assigned)
+            bad, vreasons = validate_placements(ctx, assigned)
+            if bad.size:
+                for reason in sorted(vreasons):
+                    metrics.register_solver_output_rejected(
+                        reason, vreasons[reason]
+                    )
+                if rung != "native":
+                    # Corrupted device output: same containment as a
+                    # rung exception — feed the breaker's failure
+                    # streak and re-solve this cycle ONE rung down.
+                    ssn.register_inflight_solve(None)
+                    handle = None
+                    ladder.append({
+                        "rung": rung, "outcome": "rejected",
+                        "rejected": int(bad.size),
+                        "reasons": dict(sorted(vreasons.items())),
+                    })
+                    BREAKER.record_device_failure(
+                        "rejected", exc="ValidationRejected"
+                    )
+                    nxt = rungs[idx + 1]
+                    idx = rungs.index(nxt)
+                    metrics.register_solver_fallback(
+                        rung, nxt, "rejected"
+                    )
+                    note_fallback(
+                        rung, nxt, "rejected", exc="ValidationRejected"
+                    )
+                    logger.error(
+                        "solve rung %r output failed post-solve "
+                        "validation (%s; %d placement(s)); re-solving "
+                        "this cycle on %r", rung, vreasons,
+                        int(bad.size), nxt,
+                    )
+                    if nxt == "dense":
+                        cur_inputs = strip_candidates(cur_inputs)
+                    continue
+                # Native floor: nothing below it — DROP the offending
+                # placements (they never reach bind dispatch) and keep
+                # the rest of the cycle's work.
+                assigned = np.array(assigned, copy=True)
+                assigned[bad] = -1
+                ladder.append({
+                    "rung": rung, "outcome": "rejected-dropped",
+                    "rejected": int(bad.size),
+                    "reasons": dict(sorted(vreasons.items())),
+                })
+                logger.error(
+                    "native-floor output failed post-solve validation "
+                    "(%s); dropped %d placement(s) before dispatch",
+                    vreasons, int(bad.size),
+                )
             if rung != "native" and not ladder:
                 # Only a CLEAN device cycle resets the failure streak.
                 # A cycle rescued by a lower device rung (sparse failed,
@@ -714,6 +777,11 @@ class AllocateTpuAction(Action):
         _record_phase("solve", (time.perf_counter() - t0) * 1e3)
         last_stats.update(backend=backend, rounds=rounds)
         last_stats["solve_ladder"] = ladder
+        rejected_total = sum(e.get("rejected", 0) for e in ladder)
+        if rejected_total:
+            # Post-solve validation rejected placements somewhere on the
+            # ladder (descended rung and/or native-floor drops).
+            last_stats["validation_rejected"] = rejected_total
         if len(ladder) > 1:
             # Rung descents happened: flag the cycle as degraded so the
             # bench/flight-record readers need no ladder parsing.

@@ -51,6 +51,13 @@ class ObjectMeta:
     creation_timestamp: float = 0.0
     deletion_timestamp: Optional[float] = None
     owner_uid: Optional[str] = None  # analog of metav1.OwnerReference controller UID
+    # Monotone per-cluster write stamp (the k8s resourceVersion analog):
+    # assigned by InProcessCluster on every create/update/delete and
+    # delivered with each watch event, so the cache's ingest guards can
+    # detect duplicate, stale, out-of-order, and MISSING events
+    # (doc/design/robustness.md, event-stream hardening). 0 = never
+    # written through a versioning cluster.
+    resource_version: int = 0
 
     def __post_init__(self):
         if not self.uid:

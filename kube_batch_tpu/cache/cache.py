@@ -20,6 +20,7 @@ import os
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
@@ -255,6 +256,44 @@ class SchedulerCache(Cache, EventHandlersMixin):
         # pod of ours lands in the mirror.
         self._arrival_listener = None
 
+        # --- event-stream integrity (doc/design/robustness.md) ---------
+        # Per-object resourceVersion memos + stream gap tracking,
+        # guarded by self.mutex (the ingest path already serializes on
+        # it). A versioning cluster (InProcessCluster) delivers each
+        # watch event with a monotone rv; the guards absorb duplicate,
+        # stale, and out-of-order delivery (counted in
+        # cache_event_anomalies_total{kind}) and detect DROPPED events
+        # as persistent holes in the rv stream — repaired by a bounded,
+        # rate-limited relist through the drain_resync_queue seam
+        # instead of a process restart. rv-less events (direct handler
+        # calls in tests, list replay, KubeCluster's opaque string rvs)
+        # bypass the guards entirely.
+        self._watch_rv: Dict[tuple, int] = {}
+        self._watch_deleted: deque = deque()
+        self._stream_max_rv = 0
+        # True once a stream position is established (start_ingest's
+        # list adoption, or the first admitted event): only then is a
+        # jump past max+1 a HOLE rather than a mid-stream attach.
+        self._stream_baselined = False
+        self._stream_missing: set = set()
+        self._stream_missing_prev: set = set()
+        self._event_anomalies: Dict[str, int] = {}
+        self._anomaly_flush: list = []
+        self._relist_pending = False
+        # Injectable clock for relist rate limiting: the simulator
+        # installs its virtual clock so record and replay gate relists
+        # identically; production uses the monotonic wall clock.
+        self._relist_clock = time.monotonic
+        self._relist_last: Optional[float] = None
+        self._relist_min_interval = float(
+            os.environ.get("KBT_RELIST_MIN_INTERVAL", "5")
+        )
+        self._relist_stats = {"ok": 0, "failed": 0}
+        # Anti-entropy reconciler (cache/antientropy.py), built lazily:
+        # the periodic divergence sweep and the gap-repair relist share
+        # one reconcile engine.
+        self._antientropy = None
+
         # Bind-intent journal (doc/design/robustness.md, failover):
         # at commit-dispatch time every bind batch appends a durable
         # intent record to the cluster's journal seam BEFORE any side
@@ -462,7 +501,26 @@ class SchedulerCache(Cache, EventHandlersMixin):
             ("PodDisruptionBudget", DELETED): self.delete_pdb,
         }
 
-    def _on_watch_event(self, kind: str, event_type: str, obj) -> None:
+    def _on_watch_event(self, kind: str, event_type: str, obj,
+                        rv=None) -> None:
+        if rv is None:
+            self._dispatch_event(kind, event_type, obj)
+            return
+        # Admission and application are ATOMIC under the mutex: two
+        # concurrent deliveries for the same object could otherwise be
+        # admitted in rv order but applied inverted (B's DELETE rv=N+1
+        # lands between A's admit of rv=N and A's apply), resurrecting
+        # deleted state — exactly the regression the guard exists to
+        # prevent. The mutex is re-entrant; handlers take it anyway.
+        # Anomaly metrics flush AFTER the hold (no foreign locks under
+        # cache.mutex).
+        with self.mutex:
+            admitted = self._admit_event(kind, event_type, obj, rv)
+            if admitted:
+                self._dispatch_event(kind, event_type, obj)
+        self._flush_anomaly_metrics()
+
+    def _dispatch_event(self, kind: str, event_type: str, obj) -> None:
         fn = self._dispatch.get((kind, event_type))
         if fn is not None:
             try:
@@ -471,6 +529,262 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 logger.exception(
                     "failed to handle %s %s event in cache", kind, event_type
                 )
+
+    # -- event-stream integrity guards ---------------------------------------
+
+    # Per-object memos for objects already DELETED are pruned once the
+    # stream has moved this far past the deletion — a very-late stale
+    # event for a long-dead object is then applied-and-reconciled like
+    # any rv-less event instead of guarded, which is safe (handlers are
+    # idempotent) and keeps the memo map O(live objects).
+    _WATCH_MEMO_WINDOW = 4096
+
+    @staticmethod
+    def _event_key(kind: str, obj) -> str:
+        """Guard identity for one watched object. Pods key on uid (a
+        recreated pod under the same name is a NEW object whose events
+        must not be judged against its predecessor's versions);
+        everything else keys on namespace/name like the cluster store."""
+        if kind == "Pod":
+            try:
+                return obj.uid
+            except AttributeError:
+                pass
+        meta = obj.metadata
+        return f"{meta.namespace}/{meta.name}" if meta.namespace else meta.name
+
+    def _note_anomaly_locked(self, kind: str, n: int = 1) -> None:
+        """Count one absorbed anomaly into the state dict (caller holds
+        the mutex). The Prometheus side is flushed AFTER the mutex is
+        released (_flush_anomaly_metrics) — no foreign locks are taken
+        under cache.mutex."""
+        self._event_anomalies[kind] = (
+            self._event_anomalies.get(kind, 0) + n
+        )
+        self._anomaly_flush.append((kind, n))
+
+    def _flush_anomaly_metrics(self) -> None:
+        # Lock-free fast path: anomalies are rare, and re-acquiring the
+        # mutex on EVERY admitted event just to find the flush list
+        # empty would double ingest-path mutex traffic. A benignly
+        # stale non-empty miss only defers the flush to the next event
+        # or checkpoint (appends happen under the mutex).
+        if not self._anomaly_flush:
+            return
+        with self.mutex:
+            pending, self._anomaly_flush = self._anomaly_flush, []
+        if not pending:
+            return
+        try:
+            from .. import metrics
+
+            for kind, n in pending:
+                metrics.register_event_anomaly(kind, n)
+        except Exception:  # pragma: no cover - metrics must never kill
+            logger.exception("event anomaly metric update failed")
+
+    def _admit_event(self, kind: str, event_type: str, obj,
+                     rv) -> bool:
+        """Ordering/duplicate/gap guard for one watch delivery. Returns
+        False when the event must be ABSORBED (duplicate or stale —
+        applying it would regress mirror state that a newer event
+        already wrote). Only integer rvs engage the guards; KubeCluster
+        delivers opaque string rvs and relies on its own relist
+        machinery."""
+        if not isinstance(rv, int) or rv <= 0:
+            return True
+        key = (kind, self._event_key(kind, obj))
+        admit = True
+        with self.mutex:
+            # Stream-level contiguity: every write bumps the cluster's
+            # event rv by exactly one, so a hole that persists across
+            # drain checkpoints is a DROPPED event (watch gap).
+            if rv > self._stream_max_rv:
+                if (
+                    self._stream_baselined
+                    and rv > self._stream_max_rv + 1
+                ):
+                    self._stream_missing.update(
+                        range(self._stream_max_rv + 1, rv)
+                    )
+                    if len(self._stream_missing) > self._WATCH_MEMO_WINDOW:
+                        # Pathological hole: stop tracking individual
+                        # rvs and go straight to a full relist.
+                        self._note_anomaly_locked("gap")
+                        self._stream_missing.clear()
+                        self._stream_missing_prev.clear()
+                        self._relist_pending = True
+                self._stream_max_rv = rv
+                self._stream_baselined = True
+            elif rv in self._stream_missing:
+                # Late arrival of an out-of-order event: the hole was
+                # delivery reordering, not loss — absorb the anomaly
+                # count and fill the hole.
+                self._stream_missing.discard(rv)
+                self._stream_missing_prev.discard(rv)
+                self._note_anomaly_locked("reorder")
+            # Per-object ordering: a duplicate (same rv) or stale
+            # (older rv) delivery is skipped — the mirror already
+            # reflects the same-or-newer state for this object.
+            last = self._watch_rv.get(key)
+            if last is not None and rv <= last:
+                self._note_anomaly_locked(
+                    "duplicate" if rv == last else "stale"
+                )
+                admit = False
+            if admit:
+                self._watch_rv[key] = rv
+                if event_type == DELETED:
+                    self._watch_deleted.append((rv, key))
+                while (
+                    self._watch_deleted
+                    and self._watch_deleted[0][0]
+                    < self._stream_max_rv - self._WATCH_MEMO_WINDOW
+                ):
+                    old_rv, old_key = self._watch_deleted.popleft()
+                    # Only drop the memo if no NEWER object recycled
+                    # the key (a flapped node re-added by name).
+                    if self._watch_rv.get(old_key, -1) <= old_rv:
+                        self._watch_rv.pop(old_key, None)
+        # NOTE: no metric flush here — the caller (_on_watch_event)
+        # flushes after releasing its outer mutex hold.
+        return admit
+
+    def _adopt_listed_rv(self, kind: str, obj) -> None:
+        """After a list/relist applied this object's state, pin its
+        guard memo to the listed resourceVersion so late stale events
+        predating the list are absorbed, not re-applied."""
+        rv = getattr(obj.metadata, "resource_version", 0)
+        if isinstance(rv, int) and rv > 0:
+            with self.mutex:
+                key = (kind, self._event_key(kind, obj))
+                if self._watch_rv.get(key, 0) < rv:
+                    self._watch_rv[key] = rv
+
+    def _check_watch_gap(self) -> bool:
+        """Gap-confirmation checkpoint, called at the deterministic
+        drain points (drain_resync_queue; the background resync loop's
+        idle beat in production). A missing rv seen at TWO consecutive
+        checkpoints is a confirmed drop (in-flight reordering resolves
+        within one); confirmation queues a relist, and the relist runs
+        here — rate-limited — through the same drain seam. Returns True
+        when integrity state changed (the settle loop's quiescence
+        signal)."""
+        if self.cluster is None:
+            return False
+        with self.mutex:
+            confirmed = self._stream_missing & self._stream_missing_prev
+            progressed = bool(
+                self._stream_missing ^ self._stream_missing_prev
+            )
+            self._stream_missing_prev = set(self._stream_missing)
+            if confirmed:
+                self._note_anomaly_locked("gap", len(confirmed))
+                self._stream_missing -= confirmed
+                self._stream_missing_prev -= confirmed
+                self._relist_pending = True
+            pending = self._relist_pending
+        self._flush_anomaly_metrics()
+        relisted = self._maybe_relist() if pending else False
+        return relisted or bool(confirmed) or progressed
+
+    def _maybe_relist(self) -> bool:
+        """Run the gap-repair relist unless rate-limited (at most one
+        per KBT_RELIST_MIN_INTERVAL on the injectable relist clock —
+        a relist is an O(cluster) read and a storm of gaps must not
+        turn into a storm of lists). While rate-limited the gap stays
+        pending: the periodic anti-entropy sweep repairs the affected
+        objects meanwhile, and the next eligible checkpoint relists."""
+        now = self._relist_clock()
+        with self.mutex:
+            if (
+                self._relist_last is not None
+                and now - self._relist_last < self._relist_min_interval
+            ):
+                return False
+            self._relist_last = now
+        ok = False
+        try:
+            report = self.antientropy.full_reconcile()
+            ok = report is not None
+        except Exception:
+            logger.exception("watch-gap relist failed; gap stays pending")
+        with self.mutex:
+            self._relist_stats["ok" if ok else "failed"] += 1
+            if ok:
+                self._relist_pending = False
+                # The reconcile IS the stream state now: holes predating
+                # it are repaired by construction.
+                self._stream_missing.clear()
+                self._stream_missing_prev.clear()
+                cur = getattr(
+                    self.cluster, "current_resource_version", None
+                )
+                if cur is not None:
+                    try:
+                        self._stream_max_rv = max(
+                            self._stream_max_rv, int(cur())
+                        )
+                    except Exception:  # pragma: no cover - defensive
+                        logger.exception("relist stream-rv adoption failed")
+        try:
+            from .. import metrics
+
+            metrics.register_relist("ok" if ok else "failed")
+        except Exception:  # pragma: no cover - metrics must never kill
+            logger.exception("relist metric update failed")
+        return True
+
+    @property
+    def antientropy(self) -> object:
+        """The cluster-truth reconciler (cache/antientropy.py), shared
+        by the periodic divergence sweep and the gap-repair relist.
+        Constructed under the mutex: the first relist (resync thread)
+        and the first periodic sweep (scheduler thread) can race here,
+        and two engines would split the divergence counters."""
+        if self._antientropy is None:
+            from .antientropy import AntiEntropy
+
+            with self.mutex:
+                if self._antientropy is None:
+                    self._antientropy = AntiEntropy(self)
+        return self._antientropy
+
+    def run_antientropy_if_due(self) -> Optional[dict]:
+        """Scheduler hook: run the periodic anti-entropy sweep when its
+        cadence says so (see AntiEntropy.sweep_if_due)."""
+        if self.cluster is None:
+            return None
+        try:
+            return self.antientropy.sweep_if_due()
+        except Exception:  # the sweep must never fail a cycle
+            logger.exception("anti-entropy sweep failed")
+            return None
+
+    def integrity_state(self) -> dict:
+        """One JSON-friendly blob for /debug/vars and the sim report:
+        absorbed event anomalies, gap/relist state, and the anti-entropy
+        divergence counters."""
+        with self.mutex:
+            state = {
+                "event_anomalies": dict(
+                    sorted(self._event_anomalies.items())
+                ),
+                "stream_max_rv": self._stream_max_rv,
+                "stream_missing": len(self._stream_missing),
+                "relist_pending": self._relist_pending,
+                "relists": dict(self._relist_stats),
+            }
+        ae = self._antientropy
+        if ae is not None:
+            state.update(ae.state_dict())
+        else:
+            state.update({
+                "divergence_detected": {},
+                "divergence_repaired": {},
+                "sweeps": 0,
+            })
+        return state
 
     def start_ingest(self) -> None:
         """Attach the cluster watch and replay the initial object list
@@ -494,6 +808,22 @@ class SchedulerCache(Cache, EventHandlersMixin):
             ):
                 for obj in self.cluster.list_objects(kind):
                     self._on_watch_event(kind, ADDED, obj)
+                    # Pin the guard memos to the listed versions so a
+                    # late stale event predating the list is absorbed.
+                    self._adopt_listed_rv(kind, obj)
+            # The list is the stream position now: gap tracking starts
+            # from the cluster's current event rv, not from whatever
+            # watch event happens to arrive first.
+            cur = getattr(self.cluster, "current_resource_version", None)
+            if cur is not None:
+                try:
+                    with self.mutex:
+                        self._stream_max_rv = max(
+                            self._stream_max_rv, int(cur())
+                        )
+                        self._stream_baselined = True
+                except Exception:  # pragma: no cover - defensive
+                    logger.exception("initial stream-rv adoption failed")
             self._synced = True
 
     def run(self, stop_event: Optional[threading.Event] = None) -> None:
@@ -563,6 +893,13 @@ class SchedulerCache(Cache, EventHandlersMixin):
             try:
                 task, attempt = self.err_tasks.get(timeout=0.2)
             except queue.Empty:
+                # Idle beat: the watch-gap checkpoint (and its
+                # rate-limited relist) runs here in production — the
+                # same seam the simulator drives via drain_resync_queue.
+                try:
+                    self._check_watch_gap()
+                except Exception:
+                    logger.exception("watch-gap checkpoint failed")
                 continue
             try:
                 self._sync_task(task)
@@ -575,11 +912,20 @@ class SchedulerCache(Cache, EventHandlersMixin):
         """Synchronously reconcile every queued failed-side-effect task,
         in sorted order (queue arrival order depends on worker-thread
         timing; sorting makes the drain — and therefore a simulated
-        cycle's end state — deterministic). Returns the number of tasks
-        processed. The background resync loop and this drain are
-        mutually exclusive by construction: the loop only runs when
-        :meth:`run` started it, the drain is for callers that used
-        :meth:`start_ingest`."""
+        cycle's end state — deterministic). Returns the amount of work
+        done (synced tasks, plus one when the watch-gap checkpoint made
+        progress — callers loop this drain to quiescence, and a pending
+        gap confirmation or relist IS unfinished work). The background
+        resync loop and this drain are mutually exclusive by
+        construction: the loop only runs when :meth:`run` started it,
+        the drain is for callers that used :meth:`start_ingest`."""
+        # Watch-gap checkpoint first: a confirmed gap's relist repairs
+        # the mirror BEFORE stale tasks are reconciled against it.
+        gap_work = False
+        try:
+            gap_work = self._check_watch_gap()
+        except Exception:
+            logger.exception("watch-gap checkpoint failed during drain")
         tasks = []
         while True:
             try:
@@ -606,7 +952,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
                     task.namespace, task.name,
                 )
                 self._resync_task(task, attempt + 1)
-        return synced
+        return synced + (1 if gap_work else 0)
 
     def drain_cleanup_queue(self) -> int:
         """Synchronously process the deleted-job queue once: terminated

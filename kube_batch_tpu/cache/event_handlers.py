@@ -145,15 +145,40 @@ class EventHandlersMixin:
                            f"{job_err or ''} {node_err or ''}")
 
     def _update_task(self, old: TaskInfo, new: TaskInfo) -> None:
-        """Delete + re-add (reference event_handlers.go:119-129)."""
-        self._delete_task(old)
+        """Delete + re-add (reference event_handlers.go:119-129).
+        Tolerates a missing old task: an update is "make the mirror
+        match", and on the reconcile path the old entry may already be
+        gone (duplicate delivery, a prior partial delete) — raising
+        there turned one duplicate event into a resync-queue spin."""
+        try:
+            self._delete_task(old)
+        except KeyError:
+            logger.debug(
+                "update of %s/%s found no old task to delete; adding",
+                old.namespace, old.name,
+            )
         self._add_pod_locked(new.pod)
 
     def _sync_task(self, old: TaskInfo) -> None:
-        """Reconcile one task against cluster truth after a failed side effect
-        (reference event_handlers.go:99-117)."""
+        """Reconcile one task against cluster truth after a failed side
+        effect (reference event_handlers.go:99-117). The cluster read
+        runs OUTSIDE the mutex (on a real cluster it is a network GET)
+        through the typed retry policy: transient errors retry in place
+        with capped-exponential deterministic-jitter backoff, an
+        exhausted retry surfaces to the caller's requeue contract, and
+        ObjectGoneError reconciles as a delete (cluster/errors.py)."""
+        pod = None
+        if self.cluster is not None:
+            from ..cluster.errors import ObjectGoneError, retry_transient
+
+            try:
+                pod = retry_transient(
+                    lambda: self.cluster.get_pod(old.namespace, old.name),
+                    salt=f"get-pod/{old.namespace}/{old.name}",
+                )
+            except ObjectGoneError:
+                pod = None
         with self.mutex:
-            pod = self.cluster.get_pod(old.namespace, old.name) if self.cluster else None
             if pod is None:
                 try:
                     self._delete_task(old)

@@ -144,6 +144,17 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             }
         except Exception:  # pragma: no cover - probes must not 500
             logger.exception("/debug/vars robustness probe failed")
+        # State-integrity surface (doc/design/robustness.md, cluster-
+        # truth anti-entropy): absorbed event-stream anomalies, watch-
+        # gap/relist state, and the divergence sweep's cumulative
+        # detected/repaired counters — one curl answers "does the
+        # mirror still match the cluster, and what repaired it".
+        try:
+            cache = TELEMETRY.attached_cache()
+            integrity_fn = getattr(cache, "integrity_state", None)
+            out["integrity"] = integrity_fn() if integrity_fn else None
+        except Exception:  # pragma: no cover - probes must not 500
+            logger.exception("/debug/vars integrity probe failed")
         return out
 
     def do_GET(self):  # noqa: N802 (http.server API)

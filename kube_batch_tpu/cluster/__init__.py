@@ -1,8 +1,18 @@
 from .api import ADDED, DELETED, MODIFIED, ClusterAPI, InProcessCluster
+from .errors import (
+    ClusterAPIError,
+    ClusterUnavailableError,
+    ObjectGoneError,
+    TerminalClusterError,
+    TransientClusterError,
+    retry_transient,
+)
 
 __all__ = [
     "ADDED", "DELETED", "MODIFIED", "ClusterAPI", "InProcessCluster",
     "KubeCluster", "KubeConfig",
+    "ClusterAPIError", "TransientClusterError", "ClusterUnavailableError",
+    "TerminalClusterError", "ObjectGoneError", "retry_transient",
 ]
 
 

@@ -37,7 +37,34 @@ ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 
-WatchHandler = Callable[[str, str, object], None]  # (kind, event_type, obj)
+# Watch handlers take (kind, event_type, obj) and MAY take a fourth
+# ``rv`` parameter — the cluster's monotone event resourceVersion.
+# Handlers declaring it (the scheduler cache's ingest guards) receive
+# the stamp; three-parameter legacy handlers keep working (arity is
+# detected once at add_watch time).
+WatchHandler = Callable[[str, str, object], None]
+
+
+def _handler_accepts_rv(handler) -> bool:
+    """True iff ``handler`` can take the 4th resourceVersion argument.
+    Detected ONCE at registration — calling with 4 args inside a
+    try/except TypeError would mask genuine TypeErrors raised inside
+    the handler body."""
+    import inspect
+
+    try:
+        sig = inspect.signature(handler)
+    except (TypeError, ValueError):  # builtins/partials without sigs
+        return False
+    positional = 0
+    for param in sig.parameters.values():
+        if param.kind in (
+            param.POSITIONAL_ONLY, param.POSITIONAL_OR_KEYWORD
+        ):
+            positional += 1
+        elif param.kind == param.VAR_POSITIONAL:
+            return True
+    return positional >= 4
 
 
 class ClusterAPI:
@@ -120,6 +147,17 @@ class ClusterAPI:
     def list_objects(self, kind: str) -> List[object]:
         raise NotImplementedError
 
+    def list_for_relist(self, kind: str) -> List[object]:
+        """The watch-gap recovery read path: semantically
+        :meth:`list_objects`, but a DISTINCT seam so (a) backends can
+        route it through their consistent-list machinery and (b) the
+        simulator can inject typed transient failures (``relist-fail``)
+        into exactly the reconciliation reads without perturbing its
+        own bookkeeping lists. Raises the typed taxonomy
+        (cluster/errors.py) on failure; callers retry via
+        ``retry_transient``."""
+        return self.list_objects(kind)
+
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         raise NotImplementedError
 
@@ -176,7 +214,16 @@ class InProcessCluster(ClusterAPI):
         hollow kubelets."""
         self._lock = wrap_lock("cluster.store", threading.RLock())
         self._objects: Dict[str, Dict[str, object]] = {k: {} for k in self.KINDS}
-        self._watchers: List[WatchHandler] = []
+        # (handler, accepts_rv) pairs — arity detected at registration.
+        self._watchers: List[tuple] = []
+        # Monotone event resourceVersion: bumped under the store lock on
+        # every create/update/delete (incl. bind and kubelet-flip
+        # writes), stamped onto the object's metadata, and delivered
+        # with the watch event. The cache's ingest guards use it to
+        # detect duplicate/stale/out-of-order delivery and — via the
+        # strict +1 contiguity of the stream — DROPPED events
+        # (doc/design/robustness.md, event-stream hardening).
+        self._event_rv = 0
         self.simulate_kubelet = simulate_kubelet
         self.kubelet_delay = kubelet_delay
         self._kubelet_queue: "deque" = deque()
@@ -212,30 +259,55 @@ class InProcessCluster(ClusterAPI):
         meta = obj.metadata
         return f"{meta.namespace}/{meta.name}" if meta.namespace else meta.name
 
-    def _notify(self, kind: str, event_type: str, obj) -> None:
-        for handler in list(self._watchers):
-            handler(kind, event_type, obj)
+    def _stamp_rv(self, obj) -> int:
+        """Assign the next event resourceVersion (caller holds the
+        store lock) and stamp it onto the object's metadata."""
+        self._event_rv += 1
+        rv = self._event_rv
+        try:
+            obj.metadata.resource_version = rv
+        except AttributeError:  # pragma: no cover - foreign object
+            pass
+        return rv
+
+    def _notify(self, kind: str, event_type: str, obj,
+                rv: Optional[int] = None) -> None:
+        for handler, accepts_rv in list(self._watchers):
+            if accepts_rv:
+                handler(kind, event_type, obj, rv)
+            else:
+                handler(kind, event_type, obj)
 
     # -- generic object store -----------------------------------------------
 
     def create(self, kind: str, obj) -> None:
         with self._lock:
+            rv = self._stamp_rv(obj)
             self._objects[kind][self._key(obj)] = obj
-        self._notify(kind, ADDED, obj)
+        self._notify(kind, ADDED, obj, rv)
 
     def update(self, kind: str, obj) -> None:
         with self._lock:
+            rv = self._stamp_rv(obj)
             self._objects[kind][self._key(obj)] = obj
-        self._notify(kind, MODIFIED, obj)
+        self._notify(kind, MODIFIED, obj, rv)
 
     def delete(self, kind: str, obj) -> None:
         with self._lock:
+            rv = self._stamp_rv(obj)
             self._objects[kind].pop(self._key(obj), None)
-        self._notify(kind, DELETED, obj)
+        self._notify(kind, DELETED, obj, rv)
 
     def list_objects(self, kind: str) -> List[object]:
         with self._lock:
             return list(self._objects[kind].values())
+
+    def current_resource_version(self) -> int:
+        """The newest event resourceVersion assigned so far — the
+        stream position a relist is consistent WITH (the cache resets
+        its gap tracking to it after a successful reconcile)."""
+        with self._lock:
+            return self._event_rv
 
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         with self._lock:
@@ -243,14 +315,16 @@ class InProcessCluster(ClusterAPI):
 
     def add_watch(self, handler: WatchHandler) -> None:
         with self._lock:
-            self._watchers.append(handler)
+            self._watchers.append((handler, _handler_accepts_rv(handler)))
 
     def remove_watch(self, handler: WatchHandler) -> None:
         with self._lock:
-            try:
-                self._watchers.remove(handler)
-            except ValueError:
-                pass
+            # Equality, not identity: handlers are usually bound
+            # methods, and each attribute access mints a fresh bound-
+            # method object (== compares __self__/__func__).
+            self._watchers = [
+                entry for entry in self._watchers if entry[0] != handler
+            ]
 
     # -- bind-intent journal -------------------------------------------------
 
@@ -404,7 +478,8 @@ class InProcessCluster(ClusterAPI):
             stored.spec.node_name = hostname
             if self.simulate_kubelet and self.kubelet_delay <= 0:
                 stored.status.phase = PodPhase.RUNNING
-        self._notify("Pod", MODIFIED, stored)
+            rv = self._stamp_rv(stored)
+        self._notify("Pod", MODIFIED, stored, rv)
         if self.simulate_kubelet and self.kubelet_delay > 0:
             self._enqueue_kubelet_start(self._key(stored))
 
@@ -450,7 +525,8 @@ class InProcessCluster(ClusterAPI):
                 ):
                     continue
                 pod.status.phase = PodPhase.RUNNING
-            self._notify("Pod", MODIFIED, pod)
+                rv = self._stamp_rv(pod)
+            self._notify("Pod", MODIFIED, pod, rv)
 
     def delete_pod(self, pod: Pod) -> None:
         """Analog of pod DELETE for eviction (reference cache.go:137-148)."""
@@ -544,8 +620,9 @@ class InProcessCluster(ClusterAPI):
 
     def update_pod_group(self, pg: PodGroup) -> None:
         with self._lock:
+            rv = self._stamp_rv(pg)
             self._objects["PodGroup"][self._key(pg)] = pg
-        self._notify("PodGroup", MODIFIED, pg)
+        self._notify("PodGroup", MODIFIED, pg, rv)
 
     def record_event(self, obj, event_type: str, reason: str, message: str) -> None:
         with self._lock:

@@ -435,7 +435,7 @@ solver_fallback = REGISTRY.register(
     Counter(
         "solver_fallback_total",
         "Solve-ladder descents by rung pair and reason "
-        "(exception/timeout/breaker-open/tensorize) — the "
+        "(exception/timeout/breaker-open/tensorize/rejected) — the "
         "fault-containment layer re-solving a cycle on a lower rung "
         "instead of failing it",
     ),
@@ -490,6 +490,57 @@ bind_journal_intents = REGISTRY.register(
         "drains), resolved (records fully marked and self-pruned)",
     ),
     ("event",),
+)
+# Cluster-truth anti-entropy (doc/design/robustness.md, event-stream
+# hardening): watch-ingest guard absorptions, gap-repair relists, the
+# divergence sweep's detections/repairs, and post-solve placement
+# validation rejections.
+cache_event_anomalies = REGISTRY.register(
+    Counter(
+        "cache_event_anomalies_total",
+        "Watch-event anomalies absorbed by the cache ingest guards: "
+        "duplicate (same resourceVersion redelivered), stale (older "
+        "than the applied version), reorder (out-of-order arrival that "
+        "filled a stream hole), gap (a hole confirmed as a DROPPED "
+        "event — queues a rate-limited relist)",
+    ),
+    ("kind",),
+)
+cache_relists = REGISTRY.register(
+    Counter(
+        "cache_relists_total",
+        "Watch-gap repair relists (bounded, rate-limited full "
+        "reconciles through the anti-entropy engine) by outcome",
+    ),
+    ("outcome",),
+)
+cache_divergence_detected = REGISTRY.register(
+    Counter(
+        "cache_divergence_detected_total",
+        "Mirror-vs-cluster-truth divergences found by the anti-entropy "
+        "sweep, by kind (phantom-task/missed-pod/missed-bind/"
+        "stale-task/vanished-node/missed-node/stale-node)",
+    ),
+    ("kind",),
+)
+cache_divergence_repaired = REGISTRY.register(
+    Counter(
+        "cache_divergence_repaired_total",
+        "Divergences repaired through the dirty-ledger-stamping event "
+        "handlers, by kind — detected minus repaired is the deferred "
+        "backlog the next sweep retries",
+    ),
+    ("kind",),
+)
+solver_output_rejected = REGISTRY.register(
+    Counter(
+        "solver_output_rejected_total",
+        "Solver placements rejected by post-solve validation before "
+        "bind dispatch, by reason (bad-index/infeasible/capacity) — a "
+        "device rung whose output fails validation re-solves one rung "
+        "down; the native floor drops the offending placements",
+    ),
+    ("reason",),
 )
 scheduler_failover_recoveries = REGISTRY.register(
     Counter(
@@ -843,6 +894,36 @@ def update_telemetry_watermarks(
 def register_journal_event(event: str) -> None:
     """One bind-intent journal lifecycle event (cache/cache.py)."""
     bind_journal_intents.inc((event,))
+
+
+def register_event_anomaly(kind: str, n: int = 1) -> None:
+    """``n`` absorbed watch-event anomalies of ``kind`` (cache ingest
+    guards, cache/cache.py _admit_event)."""
+    if n:
+        cache_event_anomalies.inc((kind,), amount=float(n))
+
+
+def register_relist(outcome: str) -> None:
+    """One watch-gap repair relist attempt (cache/cache.py)."""
+    cache_relists.inc((outcome,))
+
+
+def register_divergence(event: str, kind: str, n: int = 1) -> None:
+    """``n`` anti-entropy divergences of ``kind``; ``event`` is
+    detected|repaired (cache/antientropy.py)."""
+    if not n:
+        return
+    if event == "detected":
+        cache_divergence_detected.inc((kind,), amount=float(n))
+    else:
+        cache_divergence_repaired.inc((kind,), amount=float(n))
+
+
+def register_solver_output_rejected(reason: str, n: int = 1) -> None:
+    """``n`` solver placements rejected by post-solve validation
+    (solver/validate.py via the allocate_tpu ladder)."""
+    if n:
+        solver_output_rejected.inc((reason,), amount=float(n))
 
 
 def register_failover_recovery(outcome: str, count: int = 1) -> None:

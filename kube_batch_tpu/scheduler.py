@@ -637,6 +637,15 @@ class Scheduler:
             self._pending_recovery_note = None
         if self.watchdog is not None:
             self.watchdog.cycle_begin(cycle)
+        # Anti-entropy sweep (cache/antientropy.py) BEFORE the session
+        # opens: divergence repairs land in the mirror + dirty ledger
+        # first, so this cycle's snapshot — and the warm-start plan
+        # judging it — already sees the reconciled world. Periodic
+        # cycles only (run_micro never sweeps); cadence and budget are
+        # the sweeper's own (KBT_ANTIENTROPY_EVERY), and a sweep failure
+        # never fails the cycle.
+        with span("antientropy"):
+            self.cache.run_antientropy_if_due()
         cycle_start = time.perf_counter()
         with span("cycle"):
             with deferred_gc():

@@ -8,7 +8,10 @@ assert failed (``--require-sparse-sharded`` — the run never solved
 through the multi-device sparse path, or ``--host-devices`` could not
 re-shape an already-initialized backend); 6 the failover drill was
 incomplete (``--require-kill-cuts`` — a required leader-kill cut never
-fired, or a successor recovery pass reported errors).
+fired, or a successor recovery pass reported errors); 7 the
+divergence-repair assert failed (``--require-divergence-repaired`` —
+a divergence was left unrepaired at run end, or the run injected no
+event/solver-corrupt faults at all and proved nothing).
 """
 
 from __future__ import annotations
@@ -126,6 +129,17 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
         help="force >=N virtual CPU host devices before the first "
              "backend resolution (multi-device sharding smokes)")
     parser.add_argument(
+        "--antientropy-every", type=int, default=None, metavar="N",
+        help="anti-entropy sweep cadence for the run (cycles between "
+             "sweeps; 1 = every cycle, recorded in the trace header "
+             "for replay; default: the process KBT_ANTIENTROPY_EVERY)")
+    parser.add_argument(
+        "--require-divergence-repaired", action="store_true",
+        help="exit 7 unless every fault-induced divergence was "
+             "repaired by run end (report.integrity.unrepaired_end == "
+             "0) and at least one event-stream/solver-corrupt fault "
+             "actually fired")
+    parser.add_argument(
         "--require-sparse-sharded", action="store_true",
         help="exit 5 unless at least one cycle's sparse solve ran "
              "sharded over the device mesh "
@@ -193,6 +207,7 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         replay=replay,
         replay_limit=ns.replay_cycles,
         micro_every=ns.micro_every,
+        antientropy_every=ns.antientropy_every,
         kill_plan=parse_kill_plan(ns.kill_at),
         check_invariants=ns.check,
         soak=ns.soak,
@@ -296,4 +311,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
             return 6
+    if ns.require_divergence_repaired:
+        from .faults import EVENT_FAULT_KINDS
+
+        integrity = report.integrity or {}
+        injected = sum(
+            report.fault_counts.get(k, 0)
+            for k in EVENT_FAULT_KINDS + ("relist-fail", "solver-corrupt")
+        )
+        unrepaired = integrity.get("unrepaired_end", -1)
+        if unrepaired != 0 or injected == 0:
+            print(
+                f"sim: divergence-repair assert failed — "
+                f"unrepaired_end={unrepaired}, "
+                f"event/corrupt faults injected={injected} "
+                f"(--require-divergence-repaired)",
+                file=sys.stderr,
+            )
+            return 7
     return 0

@@ -77,13 +77,21 @@ class SimClusterEndpoint(ClusterAPI):
 
     supports_bind_journal = True
 
-    def __init__(self, inner, seed: int):
+    def __init__(self, inner, seed: int, fault_injector=None):
         self.inner = inner
         self.seed = seed
+        # Event-stream fault seam (sim/faults.py): when set, watch
+        # handlers registered through this endpoint are wrapped in the
+        # injector's delivery interceptor (drop/dup/reorder/stale), and
+        # list_for_relist consults its relist-fail seam.
+        self.fault_injector = fault_injector
         self._cut: Optional[str] = None
         self._kill_cycle = -1
         self._dead = False
         self._handlers: List = []
+        # original handler -> the interceptor wrapper registered for it
+        # (remove_watch is handed the original; see add_watch).
+        self._wrapped: dict = {}
         # Deterministic forensics for the trace's failover block —
         # byte-compared at replay, and incremented from the cache's
         # CONCURRENT side-effect workers, so the += must be atomic
@@ -147,21 +155,43 @@ class SimClusterEndpoint(ClusterAPI):
             return []
         return self.inner.list_objects(kind)
 
+    def list_for_relist(self, kind: str) -> list:
+        """The cache's reconcile-read seam: the injector's relist-fail
+        fault raises a typed TransientClusterError here — the harness's
+        own bookkeeping reads go through list_objects and never see
+        it."""
+        if self._dead:
+            return []
+        if self.fault_injector is not None:
+            self.fault_injector.on_relist(kind)
+        return self.inner.list_objects(kind)
+
+    def current_resource_version(self) -> int:
+        return self.inner.current_resource_version()
+
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         if self._dead:
             return None
         return self.inner.get_pod(namespace, name)
 
     def add_watch(self, handler: object) -> None:
-        self._handlers.append(handler)
-        self.inner.add_watch(handler)
+        registered = handler
+        if self.fault_injector is not None:
+            registered = self.fault_injector.wrap_watch_handler(handler)
+            # remove_watch gets the ORIGINAL handler back; remember
+            # which wrapper was registered for it, or the detach would
+            # silently match nothing and the watch would keep firing.
+            self._wrapped[handler] = registered
+        self._handlers.append(registered)
+        self.inner.add_watch(registered)
 
     def remove_watch(self, handler: object) -> None:
+        registered = self._wrapped.pop(handler, handler)
         try:
-            self._handlers.remove(handler)
+            self._handlers.remove(registered)
         except ValueError:
             pass
-        self.inner.remove_watch(handler)
+        self.inner.remove_watch(registered)
 
     # -- binds ---------------------------------------------------------------
 

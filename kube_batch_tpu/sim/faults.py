@@ -15,6 +15,12 @@ Spec grammar (doc/design/simulator.md): comma-separated
 | ``solver-exc`` | device-fault hook | the device-solve materialization raises for the cycle; the containment ladder must re-solve on a lower rung |
 | ``solver-hang``| device-fault hook | the device-solve materialization outsleeps the solve budget; the fetch deadline must abandon it and drop to native |
 | ``backend-loss``| device-fault hook | device solves AND the breaker's canary probe raise for a seeded 1-4 cycles (device lost); the breaker must hold open until the window closes, then re-promote |
+| ``event-drop``  | watch interceptor | a Pod/Node watch event is never delivered — the mirror silently diverges; gap detection (relist) + the anti-entropy sweep must repair it |
+| ``event-dup``   | watch interceptor | the event is delivered twice (same rv); the ingest guard must absorb the duplicate |
+| ``event-reorder``| watch interceptor | delivery SWAP: stashed and delivered after the next event (flushed at the cycle barrier) |
+| ``event-stale`` | watch interceptor | the object's previous event (older rv) is redelivered after the current one; the per-object guard must skip it |
+| ``relist-fail`` | relist seam | list_for_relist raises a typed TransientClusterError (hash per call); the deterministic-jitter retry ladder absorbs it |
+| ``solver-corrupt``| result tamper hook | a device rung's fetched assignment vector is rewritten to out-of-universe indices; post-solve validation must reject it before any bind dispatches |
 
 The device-fault kinds are armed through
 ``solver.containment.set_device_fault_hook`` — the hook fires inside
@@ -36,17 +42,33 @@ Two determinism regimes:
 
 from __future__ import annotations
 
-import hashlib
 import random
 import time
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+from ..utils.determinism import hash01 as _hash01
 from ..utils.lockdebug import wrap_lock
+
+# _hash01: stable uniform [0,1) from identity parts (independent of
+# PYTHONHASHSEED and thread timing) — the shared implementation in
+# utils/determinism, under the name the sim package (and
+# sim/failover.py) has always used.
 
 FAULT_KINDS = (
     "bind", "node-flap", "node-death", "evict", "solver", "crash",
     "solver-exc", "solver-hang", "backend-loss", "leader-kill",
+    "event-drop", "event-dup", "event-reorder", "event-stale",
+    "relist-fail", "solver-corrupt",
 )
+
+# Event-stream fault kinds fire at the WATCH DELIVERY seam (the
+# injector's interceptor wraps the cache's watch handler via
+# SimClusterEndpoint.add_watch) and only on Pod/Node events — the
+# kinds the cache's relist + anti-entropy reconcile cover.
+EVENT_FAULT_KINDS = (
+    "event-drop", "event-dup", "event-reorder", "event-stale",
+)
+_EVENT_FAULT_TARGET_KINDS = frozenset({"Pod", "Node"})
 
 
 class SimBindFailure(RuntimeError):
@@ -80,15 +102,6 @@ def parse_fault_spec(spec: str) -> Dict[str, float]:
             raise ValueError(f"fault probability out of [0,1]: {term!r}")
         out[kind] = p
     return out
-
-
-def _hash01(*parts) -> float:
-    """Stable uniform [0,1) from identity parts (independent of
-    PYTHONHASHSEED and thread timing)."""
-    h = hashlib.blake2b(
-        "\x1f".join(str(p) for p in parts).encode(), digest_size=8
-    ).digest()
-    return int.from_bytes(h, "big") / 2**64
 
 
 class _FaultyBinder:
@@ -139,6 +152,28 @@ class FaultInjector:
         # cycle (exclusive). Consulted by the containment-layer hook.
         self._solver_fault: Optional[str] = None
         self._backend_loss_until = -1
+        # Result-corruption state (solver-corrupt): armed per cycle;
+        # the containment tamper hook rewrites a device rung's fetched
+        # assignment vector deterministically (hash of seed+cycle).
+        self._corrupt_cycle = False
+        # Event-stream fault state (event-drop/dup/reorder/stale,
+        # relist-fail): armed for the whole cycle window (events apply
+        # BEFORE the scheduling step, so arming rides
+        # begin_cycle_events, not begin_cycle). Decisions are pure
+        # hashes of (seed, fault, object key, per-key delivery seq) —
+        # the bind-seam determinism regime: deliveries happen on
+        # concurrent watch/side-effect threads, so a shared RNG stream
+        # would be timing-dependent.
+        self._events_active = False
+        self._event_cycle = -1
+        self._event_seq: Dict[Tuple[str, str], int] = {}
+        self._reorder_stash: List[tuple] = []
+        self._stale_memo: Dict[Tuple[str, str], tuple] = {}
+        self._event_forensics: Dict[str, int] = {}
+        self._dropped_events: List[Tuple[str, str, str]] = []
+        self._relist_calls = 0
+        self._relist_fails = 0
+        self._wrapped_inner = None
         # Forensics drained by the harness each cycle. _bind_faults
         # counts the hash-decided failures only (doomed-node rejections
         # ride under their planned node-death event).
@@ -204,6 +239,11 @@ class FaultInjector:
             events.append({
                 "kind": "backend-loss", "down_for": rng.randint(1, 4),
             })
+        if (
+            spec.get("solver-corrupt", 0.0)
+            and rng.random() < spec["solver-corrupt"]
+        ):
+            events.append({"kind": "solver-corrupt"})
         p_kill = spec.get("leader-kill", 0.0)
         if p_kill and rng.random() < p_kill:
             from .failover import CUT_POINTS
@@ -216,13 +256,24 @@ class FaultInjector:
     # -- cycle arming --------------------------------------------------------
 
     def begin_cycle(self, cycle: int, doomed_nodes: Sequence[str] = (),
-                    solver_fault: Optional[str] = None) -> None:
+                    solver_fault: Optional[str] = None,
+                    corrupt: bool = False) -> None:
         with self._lock:
             self._cycle = cycle
             self._active = True
             self._doomed = set(doomed_nodes)
             self._killed_mid_cycle = set()
             self._solver_fault = solver_fault  # "exc" | "hang" | None
+            self._corrupt_cycle = bool(corrupt)
+
+    def begin_cycle_events(self, cycle: int) -> None:
+        """Arm the event-stream fault seam for this cycle's whole
+        window (workload events apply BEFORE the scheduling step, so
+        this is called ahead of :meth:`begin_cycle`)."""
+        with self._lock:
+            self._events_active = True
+            self._event_cycle = cycle
+            self._relist_calls = 0
 
     def note_backend_loss(self, cycle: int, down_for: int) -> None:
         """Open (or extend) a backend-loss window: device solves AND
@@ -263,6 +314,167 @@ class FaultInjector:
 
         return hook
 
+    # -- event-stream fault seam (watch delivery interceptor) ----------------
+
+    @staticmethod
+    def _event_subject(kind: str, obj) -> str:
+        if kind == "Pod":
+            try:
+                return obj.uid
+            except AttributeError:
+                pass
+        return obj.metadata.name
+
+    def _decide_event_fault_locked(self, kind: str, obj) -> Optional[str]:
+        """One delivery's fault decision (caller holds the lock):
+        drop > reorder > dup > stale, each drawn from a pure hash of
+        (seed, fault, kind, key, per-key delivery seq)."""
+        if not self._events_active or kind not in _EVENT_FAULT_TARGET_KINDS:
+            return None
+        key = self._event_subject(kind, obj)
+        seq = self._event_seq.get((kind, key), 0)
+        self._event_seq[(kind, key)] = seq + 1
+        for fault in EVENT_FAULT_KINDS:
+            p = self.spec.get(fault, 0.0)
+            if p and _hash01(self.seed, fault, kind, key, seq) < p:
+                return fault
+        return None
+
+    def wrap_watch_handler(self, handler: Callable) -> Callable:
+        """Interpose the event-stream fault seam between the cluster's
+        watch fan-out and the cache's ingest (installed by
+        SimClusterEndpoint.add_watch). Deliveries run OUTSIDE the
+        injector lock; only decisions and the reorder stash are locked.
+        Faulted kinds: Pod/Node (the reconcile scope of the cache's
+        relist + anti-entropy sweep)."""
+
+        def intercept(kind: str, event_type: str, obj: object,
+                      rv: Optional[int] = None) -> None:
+            deliveries: List[tuple] = []
+            with self._lock:
+                # Any arriving event flushes a stashed reordered one —
+                # delivered AFTER the current event (the swap).
+                flush, self._reorder_stash = self._reorder_stash, []
+                action = self._decide_event_fault_locked(kind, obj)
+                memo_key = (kind, self._event_subject(kind, obj))
+                prev = self._stale_memo.get(memo_key)
+                if action == "event-drop":
+                    self._event_forensics["event-drop"] = (
+                        self._event_forensics.get("event-drop", 0) + 1
+                    )
+                    self._dropped_events.append(
+                        (kind, event_type, memo_key[1])
+                    )
+                    deliveries = flush
+                elif action == "event-reorder":
+                    self._event_forensics["event-reorder"] = (
+                        self._event_forensics.get("event-reorder", 0) + 1
+                    )
+                    self._reorder_stash = [(kind, event_type, obj, rv)]
+                    deliveries = flush
+                else:
+                    deliveries = [(kind, event_type, obj, rv)] + flush
+                    if action == "event-dup":
+                        self._event_forensics["event-dup"] = (
+                            self._event_forensics.get("event-dup", 0) + 1
+                        )
+                        deliveries.append((kind, event_type, obj, rv))
+                    elif action == "event-stale" and prev is not None:
+                        self._event_forensics["event-stale"] = (
+                            self._event_forensics.get("event-stale", 0)
+                            + 1
+                        )
+                        # Redeliver the key's PREVIOUS event (older rv)
+                        # after the current one — a genuinely stale
+                        # arrival the cache guard must absorb.
+                        deliveries.append(prev)
+                if action != "event-drop":
+                    if event_type == "DELETED":
+                        self._stale_memo.pop(memo_key, None)
+                    else:
+                        self._stale_memo[memo_key] = (
+                            kind, event_type, obj, rv
+                        )
+            for d in deliveries:
+                handler(*d)
+
+        # Remember the inner target so flush_events can late-deliver a
+        # stashed reordered event at the harness's barrier. The wrapper
+        # takes 4 positional args so the versioning cluster's arity
+        # detection hands it the rv stamp.
+        self._wrapped_inner = handler
+        return intercept
+
+    def flush_events(self) -> None:
+        """Deliver any stashed reordered event (the harness calls this
+        at its deterministic barrier, before the settle drains — a
+        reorder is a SWAP, never a loss)."""
+        with self._lock:
+            stashes, self._reorder_stash = self._reorder_stash, []
+        handler = getattr(self, "_wrapped_inner", None)
+        if handler is None:
+            return
+        for kind, event_type, obj, rv in stashes:
+            handler(kind, event_type, obj, rv)
+
+    def on_relist(self, kind: str) -> None:
+        """The relist/anti-entropy read seam
+        (SimClusterEndpoint.list_for_relist): while armed, each list
+        call fails with a typed TransientClusterError by a pure hash of
+        (seed, cycle, call#) — exercising the capped-exponential retry
+        ladder while staying replay-deterministic. Per-call draws keep
+        the full-ladder-failure probability at p^attempts, so a failed
+        reconcile defers to the next sweep instead of wedging."""
+        p = self.spec.get("relist-fail", 0.0)
+        with self._lock:
+            if not self._events_active or p <= 0:
+                return
+            call = self._relist_calls
+            self._relist_calls += 1
+            fail = _hash01(
+                self.seed, "relist-fail", self._event_cycle, kind, call
+            ) < p
+            if fail:
+                self._relist_fails += 1
+        if fail:
+            from ..cluster.errors import TransientClusterError
+
+            raise TransientClusterError(
+                f"injected relist failure ({kind} list, cycle "
+                f"{self._event_cycle})"
+            )
+
+    def result_tamper_hook(self) -> Callable:
+        """The callable installed via
+        ``solver.containment.set_result_tamper_hook``: on a
+        solver-corrupt cycle, rewrite a deterministic subset of a
+        device rung's assignments to out-of-universe node indices — a
+        silent device miscompute the post-solve validation layer must
+        reject before bind dispatch."""
+
+        def tamper(assigned: object) -> object:
+            import numpy as np
+
+            with self._lock:
+                armed = self._active and self._corrupt_cycle
+                cycle = self._cycle
+            if not armed:
+                return assigned
+            arr = np.array(assigned, copy=True)
+            sel = np.nonzero(np.asarray(arr) >= 0)[0]
+            if sel.size == 0:
+                return assigned
+            k = min(4, int(sel.size))
+            for j in range(k):
+                pick = sel[
+                    int(_hash01(self.seed, "corrupt", cycle, j)
+                        * sel.size)
+                ]
+                arr[pick] = 2**30 - j  # far outside any node universe
+            return arr
+
+        return tamper
+
     def prune_bind_attempts(self, live_uids) -> int:
         """Drop per-pod bind-attempt counters for pods that no longer
         exist. A dead pod's counter is unreachable: its uid never binds
@@ -282,19 +494,33 @@ class FaultInjector:
         return len(dead)
 
     def end_cycle(self) -> dict:
-        """Disarm and drain the cycle's bind-seam forensics."""
+        """Disarm and drain the cycle's bind-seam + event-seam
+        forensics. The harness flushes the reorder stash BEFORE its
+        settle barrier, so by the time this runs no event is in
+        flight."""
         with self._lock:
             self._active = False
+            self._events_active = False
+            self._corrupt_cycle = False
             failures = sorted(self._bind_failures)
             self._bind_failures = []
             killed = sorted(self._killed_mid_cycle)
             self._doomed = set()
             bind_faults = self._bind_faults
             self._bind_faults = 0
+            event_faults = dict(sorted(self._event_forensics.items()))
+            self._event_forensics = {}
+            dropped = sorted(self._dropped_events)
+            self._dropped_events = []
+            relist_fails = self._relist_fails
+            self._relist_fails = 0
         return {
             "bind_failures": failures,
             "nodes_killed": killed,
             "bind_faults": bind_faults,
+            "event_faults": event_faults,
+            "events_dropped": dropped,
+            "relist_fails": relist_fails,
         }
 
     # -- the bind seam (side-effect pool threads) ----------------------------

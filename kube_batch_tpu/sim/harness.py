@@ -116,6 +116,12 @@ class SimConfig:
     # pins this). Defaults to <trace>.audit.jsonl when a trace is
     # recorded.
     audit_out: Optional[str] = None
+    # Anti-entropy sweep cadence override for the run (None = the
+    # process default, KBT_ANTIENTROPY_EVERY): event-fault storms run
+    # at 1 so every cycle's divergence is swept before its invariant
+    # check. Recorded in the trace header — the sweep repairs mutate
+    # scheduling state, so replay must run the same cadence.
+    antientropy_every: Optional[int] = None
 
 
 @dataclass
@@ -153,6 +159,12 @@ class SimReport:
     latency: Optional[dict] = None
     audit_records: int = 0
     audit_path: Optional[str] = None
+    # Cluster-truth integrity summary (event-stream hardening +
+    # anti-entropy): absorbed anomalies, relists, divergence
+    # detected/repaired, post-solve validation rejections, and the
+    # end-of-run cleanliness verdict (unrepaired_end must be 0 for the
+    # DIVERGE acceptance artifact; --require-divergence-repaired).
+    integrity: Optional[dict] = None
 
     @property
     def cycles_per_sec(self) -> float:
@@ -189,6 +201,8 @@ class SimReport:
                 "audit_records": self.audit_records,
                 "audit_path": self.audit_path,
             } if self.latency is not None else {}),
+            **({"integrity": self.integrity}
+               if self.integrity is not None else {}),
         }
 
 
@@ -230,6 +244,9 @@ class ClusterSimulator:
             cfg.lease_duration = header.get(
                 "lease_duration", cfg.lease_duration
             )
+            cfg.antientropy_every = header.get(
+                "antientropy_every", cfg.antientropy_every
+            )
             cfg.cycles = len(cfg.replay.cycles)
             if cfg.replay_limit is not None:
                 cfg.cycles = min(cfg.cycles, max(1, cfg.replay_limit))
@@ -244,7 +261,10 @@ class ClusterSimulator:
         # faults while exercising nothing — reject it like an unknown
         # kind rather than green-lighting a vacuous chaos run.
         device_kinds = [
-            k for k in ("solver-exc", "solver-hang", "backend-loss")
+            k for k in (
+                "solver-exc", "solver-hang", "backend-loss",
+                "solver-corrupt",
+            )
             if fault_spec.get(k)
         ]
         if cfg.backend == "native" and device_kinds:
@@ -255,6 +275,16 @@ class ClusterSimulator:
             )
         self._env_backup: Dict[str, Optional[str]] = {}
         self._apply_backend_env(cfg.backend, cfg.topk)
+        if cfg.antientropy_every is not None:
+            # Same backup/restore discipline as the backend env: the
+            # sweep cadence is part of the run's recorded semantics.
+            self._env_backup.setdefault(
+                "KBT_ANTIENTROPY_EVERY",
+                os.environ.get("KBT_ANTIENTROPY_EVERY"),
+            )
+            os.environ["KBT_ANTIENTROPY_EVERY"] = str(
+                cfg.antientropy_every
+            )
         # Fault-containment state is process-global; a run must start
         # from a closed breaker and must not inherit (or leak) a device
         # fault hook — breaker state bleeding from a recording run into
@@ -316,6 +346,12 @@ class ClusterSimulator:
             _containment.set_device_fault_hook(
                 self.injector.device_fault_hook()
             )
+            # solver-corrupt tamper seam: rewrites a device rung's
+            # fetched assignment vector on armed cycles; the post-solve
+            # validation layer must reject it before dispatch.
+            _containment.set_result_tamper_hook(
+                self.injector.result_tamper_hook()
+            )
             if cfg.backend in ("dense", "sparse"):
                 # Pre-warm the breaker's canary jit so an in-run probe
                 # costs milliseconds against the 0.5 s budget — probe
@@ -345,11 +381,17 @@ class ClusterSimulator:
             # 0.5 s wall-clock budget / fault hook would poison later
             # solves in the same process.
             _containment.set_device_fault_hook(None)
+            _containment.set_result_tamper_hook(None)
             _containment.configure(None)
             self._restore_env()
             raise
 
         self.report = SimReport()
+        # Integrity accounting: cross-instance run totals, and the
+        # process-global validation-rejection baseline (metrics persist
+        # across sims in one process; only this run's delta counts).
+        self._integrity_totals: Dict[str, object] = {}
+        self._rejected_prev = int(metrics.solver_output_rejected.total())
         # Soak mode: telemetry records every cycle; size the rollup
         # window so the WHOLE horizon fits the window ring (100k cycles
         # at /512 → ~195-cycle windows, 512 windows resident), and
@@ -407,6 +449,7 @@ class ClusterSimulator:
             self.cache.shutdown()
         finally:
             self._containment.set_device_fault_hook(None)
+            self._containment.set_result_tamper_hook(None)
             self._containment.configure(None)
             self.writer.close()
             if self._tracing:
@@ -430,6 +473,7 @@ class ClusterSimulator:
                 self._run_cycle(cycle)
                 self.clock.advance(cfg.period)
             self.report.cycles = cfg.cycles
+            self._finish_integrity()
             self.report.breaker = self._containment.BREAKER.state_dict()
             self._finish_latency()
             if cfg.soak:
@@ -456,6 +500,7 @@ class ClusterSimulator:
                 "period": cfg.period,
                 "micro_every": cfg.micro_every,
                 "lease_duration": cfg.lease_duration,
+                "antientropy_every": cfg.antientropy_every,
                 "workload": cfg.workload.to_dict(),
             }
             if cfg.kill_plan:
@@ -481,13 +526,21 @@ class ClusterSimulator:
         binder stack, and a real Scheduler. Instance 0 is the bootstrap
         leader; later instances are failover successors."""
         cfg = self.cfg
-        self.endpoint = SimClusterEndpoint(self.cluster, cfg.seed)
+        self.endpoint = SimClusterEndpoint(
+            self.cluster, cfg.seed, fault_injector=self.injector
+        )
         self.cache = SchedulerCache(
             cluster=self.endpoint,
             scheduler_name="tpu-batch",
             default_queue="default",
         )
         self.cache.leader_identity = f"sim-leader-{self.instance_id}"
+        # Relist rate limiting gates on the VIRTUAL clock, so record
+        # and replay allow/deny every gap-repair relist identically.
+        self.cache._relist_clock = self.clock.now
+        # Integrity deltas restart with the instance (a successor's
+        # cache counts from zero).
+        self._integrity_prev = None
         self.cache.binder = self.binder = _RecordingBinder(
             self.injector.wrap_binder(self.cache.binder)
         )
@@ -593,6 +646,12 @@ class ClusterSimulator:
     def _run_cycle(self, cycle: int) -> None:
         cfg = self.cfg
 
+        # 0. arm the event-stream fault seam for the whole cycle window
+        # (workload events apply before the scheduling step; the seam
+        # disarms in end_cycle, so post-event cleanup and the settle
+        # drains run fault-free and the cycle converges).
+        self.injector.begin_cycle_events(cycle)
+
         # 1. events
         if self.replaying:
             rec = (
@@ -629,7 +688,7 @@ class ClusterSimulator:
 
         # 2. faults
         doomed: List[str] = []
-        solver_fault = crash_fault = False
+        solver_fault = crash_fault = corrupt_fault = False
         kill_cut: Optional[str] = None
         device_fault = None  # "exc" | "hang" for this cycle's solves
         for fault in fault_events:
@@ -661,6 +720,8 @@ class ClusterSimulator:
                 device_fault = "hang"
             elif kind == "backend-loss":
                 self.injector.note_backend_loss(cycle, fault["down_for"])
+            elif kind == "solver-corrupt":
+                corrupt_fault = True
             elif kind == "leader-kill":
                 kill_cut = fault["cut"]
 
@@ -686,7 +747,8 @@ class ClusterSimulator:
         if kill_cut is not None:
             self.endpoint.arm_kill(kill_cut, cycle)
         self.injector.begin_cycle(
-            cycle, doomed_nodes=doomed, solver_fault=device_fault
+            cycle, doomed_nodes=doomed, solver_fault=device_fault,
+            corrupt=corrupt_fault,
         )
         prev_solver = None
         if solver_fault:
@@ -719,11 +781,15 @@ class ClusterSimulator:
             # pays the same penalty.
             self.clock.advance(self.scheduler.cycle_error_backoff())
 
-        # 4. barrier + deterministic queue drains. A killed leader's
+        # 4. barrier + deterministic queue drains. The event-fault
+        # reorder stash flushes FIRST: a stashed swap delivered at this
+        # fixed point means the settle's gap checkpoints see only
+        # genuine drops as stream holes. A killed leader's
         # instance is only barriered on its in-flight (refusing) side
         # effects — BEFORE end_cycle, so the bind seam's forensics are
         # complete when drained; its resync/cleanup queues die with the
         # process and the successor settles after recovery instead.
+        self.injector.flush_events()
         if kill_cut is not None:
             if not self.cache.wait_for_side_effects(timeout=60.0):
                 logger.warning(
@@ -752,6 +818,32 @@ class ClusterSimulator:
                 self.report.fault_counts.get("bind", 0)
                 + seam["bind_faults"]
             )
+        # Event-stream fault forensics (hash-decided at the delivery
+        # seam, like the bind faults): count them, and register every
+        # DROPPED event's subject with the invariant checker — the
+        # mirror is knowingly diverged until the relist/anti-entropy
+        # machinery repairs it, and the checker judges that repair
+        # (suppressed subjects must all clear by run end).
+        for kind, n in seam.get("event_faults", {}).items():
+            self.report.fault_counts[kind] = (
+                self.report.fault_counts.get(kind, 0) + n
+            )
+            for _ in range(n):
+                metrics.register_sim_fault(kind)
+        if seam.get("relist_fails"):
+            n = seam["relist_fails"]
+            self.report.fault_counts["relist-fail"] = (
+                self.report.fault_counts.get("relist-fail", 0) + n
+            )
+            for _ in range(n):
+                metrics.register_sim_fault("relist-fail")
+        dropped = seam.get("events_dropped", ())
+        if dropped:
+            self.checker.note_divergence(
+                cycle,
+                uids=[s for k, _e, s in dropped if k == "Pod"],
+                nodes=[s for k, _e, s in dropped if k == "Node"],
+            )
 
         # 4b. failover: the killed leader is torn down, the successor
         # takes the lease, runs the production journal-recovery pass,
@@ -774,6 +866,14 @@ class ClusterSimulator:
 
         placements = self.binder.drain()
         self._update_running_since(cycle)
+        # Per-cycle integrity delta (anomalies absorbed, relists,
+        # divergence detected/repaired, validation rejections) — part
+        # of the trace record as FORENSICS; deliberately NOT
+        # replay-compared (see the note at the replay verifier below):
+        # which cycle a gap confirmation lands on depends on worker-
+        # thread rv assignment order. Placements + the end-state
+        # repair gate are the determinism contract.
+        integrity_delta = self._integrity_delta()
 
         # 6. invariants
         violations = []
@@ -833,6 +933,8 @@ class ClusterSimulator:
         }
         if failover_info is not None:
             record["failover"] = failover_info
+        if integrity_delta is not None:
+            record["integrity"] = integrity_delta
         self.writer.write(record)
         if self.replaying and rec is not None:
             if placements != rec.get("placements", []):
@@ -842,6 +944,135 @@ class ClusterSimulator:
                 # the successor must classify, re-drive and evict
                 # identically, or the drill is not deterministic.
                 self.report.replay_mismatches.append(cycle)
+            # The integrity block is deliberately NOT byte-compared:
+            # which CYCLE a gap confirmation / relist lands on depends
+            # on the cluster's event-rv assignment order across
+            # concurrent side-effect workers (a dropped terminal rv's
+            # hole only becomes visible once a later write passes it).
+            # The true determinism contract — placements, and the
+            # end-state "every divergence repaired" gate
+            # (--require-divergence-repaired) — holds in both runs;
+            # the per-cycle block stays in the record as forensics.
+
+    def _integrity_snapshot(self) -> dict:
+        cur = self.cache.integrity_state()
+        return {
+            "anomalies": dict(cur["event_anomalies"]),
+            "relists": {
+                k: v for k, v in cur["relists"].items() if v
+            },
+            "detected": dict(cur["divergence_detected"]),
+            "repaired": dict(cur["divergence_repaired"]),
+        }
+
+    def _integrity_delta(self) -> Optional[dict]:
+        """This cycle's integrity activity as deltas of the cache's
+        cumulative counters (plus the validation-rejection metric),
+        folded into the run totals. None when nothing happened — the
+        common case, keeping clean traces byte-identical to pre-
+        integrity recordings."""
+        cur = self._integrity_snapshot()
+        prev = self._integrity_prev or {}
+        self._integrity_prev = cur
+        rejected_now = int(metrics.solver_output_rejected.total())
+        d_rejected = rejected_now - self._rejected_prev
+        self._rejected_prev = rejected_now
+        out: Dict[str, object] = {}
+        for key in ("anomalies", "relists", "detected", "repaired"):
+            base = prev.get(key, {})
+            delta = {
+                k: v - base.get(k, 0)
+                for k, v in sorted(cur[key].items())
+                if v - base.get(k, 0)
+            }
+            if delta:
+                out[key] = delta
+        if d_rejected:
+            out["rejected"] = d_rejected
+        if not out:
+            return None
+        for key, val in out.items():
+            if key == "rejected":
+                self._integrity_totals["rejected"] = (
+                    self._integrity_totals.get("rejected", 0) + val
+                )
+            else:
+                totals = self._integrity_totals.setdefault(key, {})
+                for k, v in val.items():
+                    totals[k] = totals.get(k, 0) + v
+        return out
+
+    def _finish_integrity(self) -> None:
+        """End of run: flush any stashed event, settle, run an
+        UNBUDGETED anti-entropy reconcile, verify the next sweep finds
+        nothing, and run one final invariant check — every injected
+        divergence must provably have cleared (unrepaired_end = 0 is
+        the DIVERGE acceptance gate; --require-divergence-repaired)."""
+        self.injector.flush_events()
+        self._settle()
+        # Controller-analog cleanup of pods orphaned on dead nodes by
+        # the FINAL cycles: every earlier cycle's step-5 post events
+        # handled its predecessors, but a pod ghost-bound in the last
+        # cycle (bind landed while a dropped node-delete kept the
+        # ghost in the mirror) has no later cycle to clean it — and
+        # its conservation flag would stay suppressed forever.
+        # Deterministic in replay too: it reads settled cluster state.
+        post = self._plan_post_events(
+            self.cfg.cycles, [], {"bind_failures": []}
+        )
+        for event in post:
+            self._apply_event(event, self.cfg.cycles)
+        if post:
+            self._settle()
+        unrepaired = 0
+        verify_detected: dict = {}
+        reconcile_failed = False
+        try:
+            self.cache.antientropy.sweep(budget=None)
+            self._settle()
+            verify = self.cache.antientropy.sweep(budget=None)
+            verify_detected = dict(sorted(verify["detected"].items()))
+            unrepaired = sum(verify["detected"].values())
+        except Exception:
+            logger.exception("final anti-entropy reconcile failed")
+            reconcile_failed = True
+        if self.cfg.check_invariants:
+            final = [
+                v.to_dict() for v in self.checker.check(
+                    self.cache, self.cfg.cycles, namespace=SIM_NAMESPACE
+                )
+            ]
+            for v in final:
+                metrics.register_sim_violation(v["invariant"])
+            self.report.violations.extend(final)
+        self._integrity_delta()  # fold the final sweeps into the totals
+        totals = self._integrity_totals
+        self.report.integrity = {
+            "anomalies": dict(sorted(
+                totals.get("anomalies", {}).items()
+            )),
+            "relists": dict(sorted(totals.get("relists", {}).items())),
+            "divergence_detected": dict(sorted(
+                totals.get("detected", {}).items()
+            )),
+            "divergence_repaired": dict(sorted(
+                totals.get("repaired", {}).items()
+            )),
+            "validation_rejected": totals.get("rejected", 0),
+            "suppressed_violations": self.checker.suppressed_total,
+            "unrepaired_end": (
+                unrepaired
+                + self.checker.outstanding_divergence()
+                + (1 if reconcile_failed else 0)
+            ),
+            # Forensics for a nonzero verdict: what the verify sweep
+            # still saw, and which exempt subjects never cleared.
+            "unrepaired_verify": verify_detected,
+            "unrepaired_outstanding": sorted(
+                list(self.checker.diverged_uids)
+                + list(self.checker.diverged_nodes)
+            ),
+        }
 
     def _finish_latency(self) -> None:
         """End of run: land the placement ledger's engagement summary

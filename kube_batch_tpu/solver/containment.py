@@ -156,6 +156,30 @@ def device_fault_hook() -> Optional[Callable[[str], None]]:
     return _DEVICE_FAULT_HOOK
 
 
+# callable(assigned: np.ndarray) -> np.ndarray; the simulator's
+# solver-corrupt fault TAMPERS with a device rung's fetched assignment
+# vector here — modeling a silent miscompute rather than a raise/hang —
+# so the post-solve validation layer (solver/validate.py) has a real
+# corrupted result to reject. None in production. Applied to device
+# rungs only: the native floor's result is host-computed and is the
+# trusted fallback the ladder descends to.
+_RESULT_TAMPER_HOOK: Optional[Callable] = None
+
+
+def set_result_tamper_hook(hook: Optional[Callable]) -> None:
+    global _RESULT_TAMPER_HOOK
+    _RESULT_TAMPER_HOOK = hook
+
+
+def apply_result_tamper(assigned: object) -> object:
+    """Run the sim's result-tamper hook, if armed (device rungs only —
+    see the allocate_tpu ladder)."""
+    hook = _RESULT_TAMPER_HOOK
+    if hook is None:
+        return assigned
+    return hook(assigned)
+
+
 # -- ladder helpers -----------------------------------------------------------
 
 

@@ -1,0 +1,134 @@
+"""Post-solve placement validation: the last gate before bind dispatch.
+
+The solver's output is DEVICE output — and PR 7's containment treated a
+device that raises or hangs, not one that silently miscomputes. A
+corrupted assignment vector (bit flip, bad kernel, wedged HBM) that
+reached the apply path would become real cluster binds. This module
+rechecks every proposed placement host-side, in O(placements) vectorized
+work (never O(T·N)):
+
+- **bad-index** — assignment outside [0, N): impossible for a correct
+  kernel, certain corruption;
+- **infeasible** — the placement violates the feasibility mask the
+  solve itself was given (per-element gather of the task's group row —
+  no [P, N] materialization);
+- **capacity** — a node's aggregate assigned resreq grossly exceeds its
+  idle capacity (beyond the per-task epsilon slack a legitimate solve
+  can accumulate). Sub-epsilon drift is NOT flagged here: the apply
+  path's exact sequential fit guard already degrades that to the
+  guarded per-task loop, which re-checks every task individually.
+
+The allocate_tpu ladder consumes the verdict: a device rung whose
+output fails validation is treated like a rung failure — breaker fed,
+re-solve one rung down — and the native floor drops the offending
+placements, so a corrupted result can never reach the cluster
+(doc/design/robustness.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+REJECT_REASONS = ("bad-index", "infeasible", "capacity")
+
+
+def validate_placements(
+    ctx: object, assigned: np.ndarray
+) -> Tuple[np.ndarray, Dict[str, int]]:
+    """Validate one solve's proposed placements against the feasibility
+    mask and a capacity recount. Returns ``(bad_task_indices,
+    reason_counts)`` — empty on a clean result. ``ctx`` is the
+    tensorize SnapshotContext (mask + host fit/idle arrays)."""
+    if (
+        ctx.mask is None
+        or ctx.task_req_host is None
+        or ctx.node_idle_host is None
+    ):
+        # Host validation arrays absent (legacy direct-solve callers):
+        # nothing to validate against — the apply path's sequential fit
+        # guard remains the only gate there.
+        return np.empty(0, dtype=np.int64), {}
+    T = len(ctx.tasks)
+    N = len(ctx.nodes)
+    a = np.asarray(assigned[:T])
+    # Placed = anything that is not the -1 "unassigned" sentinel: a
+    # corrupted NEGATIVE index (sign flip) must be rejected as
+    # bad-index, not silently read as unplaced — silently dropping a
+    # task is exactly the miscompute class this gate exists for.
+    sel = np.nonzero(a != -1)[0]
+    if sel.size == 0:
+        return np.empty(0, dtype=np.int64), {}
+
+    reasons: Dict[str, int] = {}
+    nodes_sel = a[sel]
+    bad_parts = []
+
+    # 1. bad-index: outside the node universe entirely.
+    oob = (nodes_sel >= N) | (nodes_sel < 0)
+    if oob.any():
+        bad_parts.append(sel[oob])
+        reasons["bad-index"] = int(oob.sum())
+    ok = ~oob
+    sel_ok = sel[ok]
+    nodes_ok = nodes_sel[ok].astype(np.int64)
+    if sel_ok.size == 0:
+        return np.unique(np.concatenate(bad_parts)), reasons
+
+    # 2. infeasible: per-element gather of each task's mask row at its
+    # assigned node — O(placements), never a [P, N] materialization.
+    mask = ctx.mask
+    feas = (
+        mask.group_rows[mask.task_group[sel_ok], nodes_ok]
+        & mask.node_ok[nodes_ok]
+    )
+    P = len(mask.pair_idx)
+    if P:
+        pos = np.clip(np.searchsorted(mask.pair_idx, sel_ok), 0, P - 1)
+        has_pair = mask.pair_idx[pos] == sel_ok
+        if has_pair.any():
+            pair_vals = mask.pair_rows[
+                pos[has_pair], nodes_ok[has_pair]
+            ]
+            feas_pair = feas[has_pair] & pair_vals
+            feas = feas.copy()
+            feas[has_pair] = feas_pair
+    infeasible = ~feas
+    if infeasible.any():
+        bad_parts.append(sel_ok[infeasible])
+        reasons["infeasible"] = int(infeasible.sum())
+
+    # 3. capacity recount: aggregate resreq per node vs idle, with a
+    # GENEROUS epsilon (per-task eps × count) so a legitimate solve's
+    # accumulated rounding can never trip it — gross oversubscription
+    # (a corrupted result concentrating tasks) still does. Offenders =
+    # every placement on an overfull node (conservative: the corrupted
+    # subset is unidentifiable host-side).
+    feas_sel = sel_ok[feas]
+    feas_nodes = nodes_ok[feas]
+    if feas_sel.size:
+        req_rows = ctx.task_req_host[feas_sel]
+        R = req_rows.shape[1]
+        # bincount per dim, not np.add.at: the unbuffered scatter costs
+        # ~3 ms at 50k placements; R bincounts run in tight C loops.
+        bins = np.empty((N, R), dtype=np.float64)
+        for r in range(R):
+            bins[:, r] = np.bincount(
+                feas_nodes, weights=req_rows[:, r], minlength=N
+            )[:N]
+        counts = np.bincount(feas_nodes, minlength=N)[:N].astype(
+            np.float64
+        )
+        eps = ctx.layout.eps().astype(np.float64)
+        slack = np.outer(np.maximum(counts, 1.0) + 1.0, eps)
+        overfull = (bins > ctx.node_idle_host + slack).any(axis=1)
+        if overfull.any():
+            on_overfull = overfull[feas_nodes]
+            if on_overfull.any():
+                bad_parts.append(feas_sel[on_overfull])
+                reasons["capacity"] = int(on_overfull.sum())
+
+    if not bad_parts:
+        return np.empty(0, dtype=np.int64), {}
+    return np.unique(np.concatenate(bad_parts)), reasons

@@ -1,0 +1,301 @@
+"""Cluster-truth anti-entropy (cache/antientropy.py): divergence
+classification per kind, budget bounding, in-flight exemption, repair
+through the dirty ledger, and warm-solve parity across a repair."""
+
+import os
+
+from kube_batch_tpu.actions.allocate_tpu import last_stats
+from kube_batch_tpu.api import PodPhase, TaskStatus, build_resource_list
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.cluster import InProcessCluster
+from kube_batch_tpu.framework import close_session, get_action, open_session
+from kube_batch_tpu.utils.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+from tests.actions.test_actions import DEFAULT_TIERS_ARGS, make_tiers
+
+
+def req(cpu="1000m", mem="1Gi"):
+    return dict(build_resource_list(cpu=cpu, memory=mem))
+
+
+def make_cluster_cache(nodes=3):
+    cluster = InProcessCluster(simulate_kubelet=True)
+    cache = SchedulerCache(
+        cluster=cluster,
+        scheduler_name="tpu-batch",
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+    for j in range(nodes):
+        cluster.create_node(build_node(
+            f"n{j}", build_resource_list(cpu="8", memory="16Gi", pods=110)
+        ))
+    cluster.create_queue(build_queue("default"))
+    cache.start_ingest()
+    return cluster, cache
+
+
+def make_pod(name, node="", phase=PodPhase.PENDING, group="g1"):
+    pod = build_pod("ns", name, node, phase, req(), group_name=group)
+    pod.spec.scheduler_name = "tpu-batch"
+    return pod
+
+
+def silent(cluster, cache, fn):
+    """Apply a cluster mutation WITHOUT watch delivery — the divergence
+    injector."""
+    cluster.remove_watch(cache._on_watch_event)
+    try:
+        fn()
+    finally:
+        cluster.add_watch(cache._on_watch_event)
+
+
+class TestClassification:
+    def test_missed_pod(self):
+        cluster, cache = make_cluster_cache()
+        silent(cluster, cache, lambda: cluster.create_pod(make_pod("p1")))
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {"missed-pod": 1}
+        assert rep["repaired"] == {"missed-pod": 1}
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 1
+        # Repair stamped the dirty ledger (warm/tensorize coherence).
+        assert "ns/g1" in cache._dirty_jobs
+        cache.shutdown()
+
+    def test_missed_bind(self):
+        cluster, cache = make_cluster_cache()
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        silent(cluster, cache, lambda: cluster.bind_pod(pod, "n0"))
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {"missed-bind": 1}
+        task = next(
+            t for j in cache.jobs.values() for t in j.tasks.values()
+        )
+        assert task.node_name == "n0"
+        assert task.uid in cache.nodes["n0"].tasks
+        cache.shutdown()
+
+    def test_phantom_task(self):
+        cluster, cache = make_cluster_cache()
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        silent(cluster, cache, lambda: cluster.delete_pod(pod))
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {"phantom-task": 1}
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 0
+        cache.shutdown()
+
+    def test_vanished_and_missed_node(self):
+        cluster, cache = make_cluster_cache()
+        node = next(
+            n for n in cluster.list_objects("Node") if n.name == "n0"
+        )
+        silent(cluster, cache, lambda: cluster.delete("Node", node))
+        new = build_node(
+            "n9", build_resource_list(cpu="8", memory="16Gi", pods=110)
+        )
+        silent(cluster, cache, lambda: cluster.create_node(new))
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {
+            "vanished-node": 1, "missed-node": 1
+        }
+        assert "n0" not in cache.nodes and "n9" in cache.nodes
+        cache.shutdown()
+
+    def test_stale_node_capacity(self):
+        cluster, cache = make_cluster_cache()
+        node = next(
+            n for n in cluster.list_objects("Node") if n.name == "n0"
+        )
+
+        def shrink():
+            node.status.allocatable = build_resource_list(
+                cpu="2", memory="4Gi", pods=110
+            )
+            cluster.update("Node", node)
+
+        silent(cluster, cache, shrink)
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {"stale-node": 1}
+        assert cache.nodes["n0"].allocatable.milli_cpu == 2000.0
+        cache.shutdown()
+
+    def test_inflight_binding_task_exempt(self):
+        """A BINDING task (side effect on the wire) must never be
+        judged against truth mid-flight."""
+        cluster, cache = make_cluster_cache()
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        job = next(iter(cache.jobs.values()))
+        task = next(iter(job.tasks.values()))
+        job.update_task_status(task, TaskStatus.BINDING)
+        task.node_name = "n0"
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {}
+        assert rep["exempt_inflight"] == 1
+        cache.shutdown()
+
+    def test_orphaned_binding_task_repaired(self):
+        """A BINDING task whose pod is GONE from truth (its bind
+        confirm AND delete were both lost) is an orphan, not
+        in-flight — the exemption must not shield it forever."""
+        cluster, cache = make_cluster_cache()
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        job = next(iter(cache.jobs.values()))
+        task = next(iter(job.tasks.values()))
+        job.update_task_status(task, TaskStatus.BINDING)
+        task.node_name = "n0"
+        silent(cluster, cache, lambda: cluster.delete_pod(pod))
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {"phantom-task": 1}
+        assert rep["repaired"] == {"phantom-task": 1}
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 0
+        cache.shutdown()
+
+    def test_terminated_orphan_repaired_but_live_terminated_skipped(self):
+        """Terminated tasks are outside the fold: a SUCCEEDED pod still
+        in the cluster is cleanup's business (no oscillation with the
+        job-cleanup queue), but a mirror-terminated task whose pod is
+        gone is a phantom."""
+        cluster, cache = make_cluster_cache()
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        pod.status.phase = PodPhase.SUCCEEDED
+        cluster.update("Pod", pod)
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {}
+        silent(cluster, cache, lambda: cluster.delete_pod(pod))
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {"phantom-task": 1}
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 0
+        cache.shutdown()
+
+    def test_budget_defers_remainder(self):
+        cluster, cache = make_cluster_cache()
+
+        def create_many():
+            for i in range(6):
+                cluster.create_pod(make_pod(f"p{i}"))
+
+        silent(cluster, cache, create_many)
+        rep = cache.antientropy.sweep(budget=2)
+        assert sum(rep["repaired"].values()) == 2
+        assert rep["deferred"] == 4
+        rep2 = cache.antientropy.sweep(budget=None)
+        assert sum(rep2["repaired"].values()) == 4
+        rep3 = cache.antientropy.sweep()
+        assert rep3["detected"] == {}
+        cache.shutdown()
+
+    def test_consistent_sweep_is_clean_and_counts(self):
+        cluster, cache = make_cluster_cache()
+        cluster.create_pod(make_pod("p1"))
+        rep = cache.antientropy.sweep()
+        assert rep["detected"] == {} and rep["buckets_dirty"] == 0
+        state = cache.integrity_state()
+        assert state["sweeps"] == 1
+        assert state["divergence_detected"] == {}
+        cache.shutdown()
+
+    def test_sweep_cadence(self, monkeypatch):
+        monkeypatch.setenv("KBT_ANTIENTROPY_EVERY", "3")
+        cluster, cache = make_cluster_cache()
+        ran = [
+            cache.run_antientropy_if_due() is not None
+            for _ in range(7)
+        ]
+        assert ran == [True, False, False, True, False, False, True]
+        monkeypatch.setenv("KBT_ANTIENTROPY", "0")
+        cache._antientropy = None
+        assert cache.run_antientropy_if_due() is None
+        cache.shutdown()
+
+
+class TestWarmParityAcrossRepair:
+    """Satellite: an anti-entropy repair must land in the dirty ledger
+    so the warm-start plan voids its carried state — the post-repair
+    solve is pinned bit-equal to a cold (KBT_WARM=0) twin run."""
+
+    def _run(self, warm: bool):
+        prev = os.environ.get("KBT_WARM")
+        if warm:
+            os.environ.pop("KBT_WARM", None)
+        else:
+            os.environ["KBT_WARM"] = "0"
+        try:
+            cluster, cache = make_cluster_cache(nodes=4)
+            action, _ = get_action("allocate_tpu")
+            tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+
+            def cycle():
+                ssn = open_session(cache, tiers)
+                action.execute(ssn)
+                outcome = last_stats.get("warm_outcome")
+                close_session(ssn)
+                assert cache.wait_for_side_effects(timeout=30.0)
+                assert cache.wait_for_bookkeeping(timeout=30.0)
+                cache.drain_resync_queue()
+                cache.drain_cleanup_queue()
+                return outcome
+
+            # Cycle 1: a wave places; cycle 2: warm steady state.
+            cluster.create_pod_group(build_pod_group(
+                "g1", namespace="ns", min_member=1, queue="default"
+            ))
+            for i in range(4):
+                cluster.create_pod(make_pod(f"a{i}"))
+            cycle()
+            outcome2 = cycle()
+            # Divergence behind the cache's back + repair by sweep.
+            silent(
+                cluster, cache,
+                lambda: cluster.create_pod(make_pod("late1")),
+            )
+            rep = cache.antientropy.sweep()
+            assert rep["repaired"] == {"missed-pod": 1}
+            # Post-repair cycle must place the repaired pod.
+            outcome3 = cycle()
+            state = sorted(
+                (t.name, t.node_name, t.status.name)
+                for j in cache.jobs.values()
+                for t in j.tasks.values()
+            )
+            idle = {
+                name: (ni.idle.milli_cpu, ni.used.milli_cpu)
+                for name, ni in sorted(cache.nodes.items())
+            }
+            cache.shutdown()
+            return state, idle, (outcome2, outcome3)
+        finally:
+            if prev is None:
+                os.environ.pop("KBT_WARM", None)
+            else:
+                os.environ["KBT_WARM"] = prev
+
+    def test_bit_equal_vs_cold(self):
+        warm_state, warm_idle, outcomes = self._run(warm=True)
+        cold_state, cold_idle, _ = self._run(warm=False)
+        assert warm_state == cold_state
+        assert warm_idle == cold_idle
+        # The warm run actually exercised the warm machinery, and the
+        # post-repair cycle did NOT sail through as a noop reuse of
+        # carried verdicts — the repair dirtied the world.
+        assert outcomes[0] in ("noop", "solve")
+        assert outcomes[1] != "noop"
+        # Every repaired pod ended placed.
+        assert any(name == "late1" and node for name, node, _s
+                   in warm_state)

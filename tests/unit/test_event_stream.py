@@ -1,0 +1,424 @@
+"""Event-stream hardening (doc/design/robustness.md): the ingest
+guards (duplicate/stale/reorder absorption, gap detection), the
+rate-limited gap-repair relist through the drain seam, the typed
+cluster-error taxonomy + deterministic retry, and the delete-handler
+idempotency regressions."""
+
+import pytest
+
+from kube_batch_tpu.api import PodPhase, TaskStatus, build_resource_list
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.cluster import InProcessCluster
+from kube_batch_tpu.cluster.errors import (
+    ClusterAPIError,
+    ObjectGoneError,
+    TerminalClusterError,
+    TransientClusterError,
+    backoff_delay,
+    deterministic_jitter,
+    retry_transient,
+)
+from kube_batch_tpu.utils.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+
+def req(cpu="1000m", mem="1Gi"):
+    return dict(build_resource_list(cpu=cpu, memory=mem))
+
+
+def make_cluster_cache():
+    cluster = InProcessCluster(simulate_kubelet=True)
+    cache = SchedulerCache(
+        cluster=cluster,
+        scheduler_name="tpu-batch",
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+    cache.start_ingest()
+    return cluster, cache
+
+
+def make_pod(name, node="", phase=PodPhase.PENDING, group="g1"):
+    pod = build_pod("ns", name, node, phase, req(), group_name=group)
+    pod.spec.scheduler_name = "tpu-batch"
+    return pod
+
+
+# ------------------------------------------------------------- guards
+
+
+class TestIngestGuards:
+    def test_duplicate_delivery_absorbed(self):
+        cluster, cache = make_cluster_cache()
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        rv = pod.metadata.resource_version
+        assert rv > 0
+        before = dict(cache.integrity_state()["event_anomalies"])
+        cache._on_watch_event("Pod", "ADDED", pod, rv)
+        anomalies = cache.integrity_state()["event_anomalies"]
+        assert anomalies.get("duplicate", 0) == before.get(
+            "duplicate", 0
+        ) + 1
+        # Mirror unchanged: still exactly one task.
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 1
+        cache.shutdown()
+
+    def test_stale_delivery_never_regresses(self):
+        cluster, cache = make_cluster_cache()
+        cluster.create_node(build_node(
+            "n1", build_resource_list(cpu="4", memory="8Gi", pods=110)
+        ))
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        cluster.bind_pod(pod, "n1")  # MODIFIED with a newer rv
+        job = next(iter(cache.jobs.values()))
+        task = next(iter(job.tasks.values()))
+        assert task.node_name == "n1"
+        # Redeliver with an OLDER rv (the bind-confirm's predecessor):
+        # the guard must skip it — the shared object's current content
+        # would be re-applied harmlessly here, but on a real cluster a
+        # stale event carries stale content.
+        cache._on_watch_event(
+            "Pod", "MODIFIED", pod, pod.metadata.resource_version - 1
+        )
+        anomalies = cache.integrity_state()["event_anomalies"]
+        assert anomalies.get("stale", 0) >= 1
+        task = next(iter(job.tasks.values()))
+        assert task.node_name == "n1"
+        cache.shutdown()
+
+    def test_reorder_fills_hole_without_gap(self):
+        cluster, cache = make_cluster_cache()
+        p1, p2 = make_pod("p1"), make_pod("p2")
+        # Deliver out of order by hand: stamp rvs via the cluster but
+        # suppress delivery, then feed the cache swapped.
+        cluster.remove_watch(cache._on_watch_event)
+        cluster.create_pod(p1)
+        cluster.create_pod(p2)
+        cluster.add_watch(cache._on_watch_event)
+        cache._on_watch_event(
+            "Pod", "ADDED", p2, p2.metadata.resource_version
+        )
+        assert cache.integrity_state()["stream_missing"] >= 1
+        cache._on_watch_event(
+            "Pod", "ADDED", p1, p1.metadata.resource_version
+        )
+        state = cache.integrity_state()
+        assert state["stream_missing"] == 0
+        assert state["event_anomalies"].get("reorder", 0) == 1
+        # Both pods landed; no gap, no relist.
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 2
+        cache.drain_resync_queue()
+        cache.drain_resync_queue()
+        assert cache.integrity_state()["relists"]["ok"] == 0
+        cache.shutdown()
+
+    def test_dropped_event_confirms_gap_and_relists(self):
+        cluster, cache = make_cluster_cache()
+        cache._relist_min_interval = 0.0
+        cluster.create_pod(make_pod("p0"))
+        # Drop p1's ADD entirely; a later event exposes the hole.
+        cluster.remove_watch(cache._on_watch_event)
+        p1 = make_pod("p1")
+        cluster.create_pod(p1)
+        cluster.add_watch(cache._on_watch_event)
+        cluster.create_pod(make_pod("p2"))
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 2
+        # Two checkpoints confirm the persistent hole → relist repairs.
+        worked = [cache.drain_resync_queue() for _ in range(3)]
+        state = cache.integrity_state()
+        assert state["event_anomalies"].get("gap", 0) == 1
+        assert state["relists"]["ok"] == 1
+        assert state["divergence_repaired"].get("missed-pod", 0) == 1
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 3
+        assert any(worked), worked
+        cache.shutdown()
+
+    def test_relist_rate_limited_on_injected_clock(self):
+        cluster, cache = make_cluster_cache()
+        now = [0.0]
+        cache._relist_clock = lambda: now[0]
+        cache._relist_min_interval = 5.0
+
+        def drop_one(name):
+            cluster.remove_watch(cache._on_watch_event)
+            cluster.create_pod(make_pod(name))
+            cluster.add_watch(cache._on_watch_event)
+            cluster.create_pod(make_pod(f"{name}-wit"))
+
+        drop_one("pa")
+        for _ in range(3):
+            cache.drain_resync_queue()
+        assert cache.integrity_state()["relists"]["ok"] == 1
+        # A second gap inside the window: relist stays pending.
+        drop_one("pb")
+        for _ in range(3):
+            cache.drain_resync_queue()
+        state = cache.integrity_state()
+        assert state["relists"]["ok"] == 1
+        assert state["relist_pending"] is True
+        # Window passes → the pending relist runs.
+        now[0] = 6.0
+        cache.drain_resync_queue()
+        state = cache.integrity_state()
+        assert state["relists"]["ok"] == 2
+        assert state["relist_pending"] is False
+        cache.shutdown()
+
+    def test_rvless_events_bypass_guards(self):
+        """Direct handler feeding (the whole existing test corpus)
+        never engages the guards."""
+        cache = SchedulerCache(
+            binder=FakeBinder(), evictor=FakeEvictor(),
+            status_updater=FakeStatusUpdater(),
+            volume_binder=FakeVolumeBinder(),
+        )
+        pod = make_pod("p1")
+        cache.add_pod(pod)
+        cache.add_pod(pod)  # idempotent, no anomaly counted
+        assert cache.integrity_state()["event_anomalies"] == {}
+        cache.shutdown()
+
+
+# ---------------------------------------------------- delete idempotency
+
+
+class TestDeleteIdempotency:
+    def test_double_delete_pod_running(self):
+        """Satellite regression: duplicate delete_pod must not
+        double-credit node capacity or escape a KeyError."""
+        cache = SchedulerCache(
+            binder=FakeBinder(), evictor=FakeEvictor(),
+            status_updater=FakeStatusUpdater(),
+            volume_binder=FakeVolumeBinder(),
+        )
+        cache.add_node(build_node(
+            "n1", build_resource_list(cpu="4", memory="8Gi", pods=110)
+        ))
+        pod = make_pod("p1", node="n1", phase=PodPhase.RUNNING)
+        cache.add_pod(pod)
+        ni = cache.nodes["n1"]
+        idle0 = ni.idle.clone()
+        idle0.add(ni.used)
+        cache.delete_pod(pod)
+        after1 = (ni.idle.milli_cpu, ni.used.milli_cpu)
+        cache.delete_pod(pod)
+        after2 = (ni.idle.milli_cpu, ni.used.milli_cpu)
+        assert after1 == after2
+        assert ni.idle.milli_cpu == idle0.milli_cpu
+        assert ni.used.is_empty()
+        cache.shutdown()
+
+    def test_double_delete_pod_releasing(self):
+        cache = SchedulerCache(
+            binder=FakeBinder(), evictor=FakeEvictor(),
+            status_updater=FakeStatusUpdater(),
+            volume_binder=FakeVolumeBinder(),
+        )
+        cache.add_node(build_node(
+            "n1", build_resource_list(cpu="4", memory="8Gi", pods=110)
+        ))
+        pod = make_pod("p1", node="n1", phase=PodPhase.RUNNING)
+        cache.add_pod(pod)
+        job = next(iter(cache.jobs.values()))
+        task = next(iter(job.tasks.values()))
+        job.update_task_status(task, TaskStatus.RELEASING)
+        cache.nodes["n1"].update_task(task)
+        cache.delete_pod(pod)
+        ni = cache.nodes["n1"]
+        releasing1 = ni.releasing.milli_cpu
+        cache.delete_pod(pod)
+        assert ni.releasing.milli_cpu == releasing1 == 0.0
+        assert ni.used.is_empty()
+        cache.shutdown()
+
+    def test_double_delete_node(self):
+        cache = SchedulerCache(
+            binder=FakeBinder(), evictor=FakeEvictor(),
+            status_updater=FakeStatusUpdater(),
+            volume_binder=FakeVolumeBinder(),
+        )
+        node = build_node(
+            "n1", build_resource_list(cpu="4", memory="8Gi", pods=110)
+        )
+        cache.add_node(node)
+        cache.delete_node(node)
+        cache.delete_node(node)  # must not raise
+        assert "n1" not in cache.nodes
+        cache.shutdown()
+
+    def test_update_task_tolerates_missing_old(self):
+        """A reconcile update of a task the mirror no longer holds must
+        ADD the new state, not raise — the KeyError used to spin the
+        resync queue until the terminal cap."""
+        cluster, cache = make_cluster_cache()
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        job = next(iter(cache.jobs.values()))
+        task = next(iter(job.tasks.values())).clone()
+        cache.delete_pod(pod)          # mirror entry gone
+        cluster.create_pod(pod)        # truth has it again (recreate)
+        cache._sync_task(task)         # must not raise
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 1
+        cache.shutdown()
+
+
+# --------------------------------------------------------- typed retry
+
+
+class TestTypedRetry:
+    def test_taxonomy(self):
+        assert issubclass(TransientClusterError, ClusterAPIError)
+        assert issubclass(ObjectGoneError, TerminalClusterError)
+
+    def test_retries_transient_then_succeeds(self):
+        calls = []
+
+        def op():
+            calls.append(1)
+            if len(calls) < 3:
+                raise TransientClusterError("blip")
+            return "ok"
+
+        slept = []
+        assert retry_transient(
+            op, attempts=4, base=0.01, cap=0.1, salt="t",
+            sleep=slept.append,
+        ) == "ok"
+        assert len(calls) == 3
+        assert len(slept) == 2
+        # Deterministic jitter: same salt+attempt → same delay.
+        assert slept[0] == backoff_delay(0, 0.01, 0.1, "t")
+
+    def test_terminal_surfaces_immediately(self):
+        calls = []
+
+        def op():
+            calls.append(1)
+            raise TerminalClusterError("schema")
+
+        with pytest.raises(TerminalClusterError):
+            retry_transient(op, attempts=4, sleep=lambda _d: None)
+        assert len(calls) == 1
+
+    def test_exhausted_raises_last(self):
+        def op():
+            raise TransientClusterError("still down")
+
+        with pytest.raises(TransientClusterError):
+            retry_transient(op, attempts=3, sleep=lambda _d: None)
+
+    def test_jitter_deterministic_and_spread(self):
+        a = deterministic_jitter("x", 0)
+        assert a == deterministic_jitter("x", 0)
+        assert a != deterministic_jitter("x", 1)
+        assert 0.0 <= a < 1.0
+
+    def test_sync_task_classifies_gone_as_delete(self):
+        cluster, cache = make_cluster_cache()
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        job = next(iter(cache.jobs.values()))
+        task = next(iter(job.tasks.values())).clone()
+
+        def gone(_ns, _name):
+            raise ObjectGoneError("404")
+
+        cache.cluster.get_pod = gone
+        cache._sync_task(task)
+        assert sum(len(j.tasks) for j in cache.jobs.values()) == 0
+        cache.shutdown()
+
+
+# ----------------------------------------- drain ordering (satellite 3)
+
+
+class TestDrainInterleaving:
+    def _cluster_with_job(self):
+        cluster, cache = make_cluster_cache()
+        cluster.create_node(build_node(
+            "n1", build_resource_list(cpu="8", memory="16Gi", pods=110)
+        ))
+        cluster.create_queue(build_queue("default"))
+        cluster.create_pod_group(build_pod_group(
+            "g1", namespace="ns", min_member=1
+        ))
+        return cluster, cache
+
+    def test_reordered_resync_items_drain_deterministically(self):
+        """Items enqueued in two different orders drain to the same end
+        state (the drain sorts)."""
+        cluster, cache = self._cluster_with_job()
+        pods = [make_pod(f"p{i}") for i in range(4)]
+        for pod in pods:
+            cluster.create_pod(pod)
+        tasks = sorted(
+            (t.clone() for j in cache.jobs.values()
+             for t in j.tasks.values()),
+            key=lambda t: t.name,
+        )
+        for order in (tasks, list(reversed(tasks))):
+            for t in order:
+                cache._resync_task(t.clone())
+            synced = cache.drain_resync_queue()
+            assert synced >= len(tasks)
+            assert sum(
+                len(j.tasks) for j in cache.jobs.values()
+            ) == len(pods)
+        cache.shutdown()
+
+    def test_interleaved_resync_and_cleanup_drains(self):
+        """Cleanup and resync queues drained in interleaved orders
+        converge: the terminated job is removed exactly once, resync
+        of its dead task reconciles as a delete."""
+        cluster, cache = self._cluster_with_job()
+        pod = make_pod("p1")
+        cluster.create_pod(pod)
+        job = next(iter(cache.jobs.values()))
+        task = next(iter(job.tasks.values())).clone()
+        # Terminate: pod succeeded then deleted from the cluster.
+        pod.status.phase = PodPhase.SUCCEEDED
+        cluster.update("Pod", pod)
+        cluster.delete_pod(pod)
+        # Interleave: resync of the dead task queued BETWEEN two
+        # cleanup passes, plus a cleanup queued after the resync.
+        cache._queue_job_cleanup(job)
+        cache.drain_cleanup_queue()
+        cache._resync_task(task.clone())
+        cache._queue_job_cleanup(job)
+        assert cache.drain_resync_queue() >= 1
+        cache.drain_cleanup_queue()
+        assert all(
+            not j.tasks for j in cache.jobs.values()
+        ), cache.jobs
+        cache.shutdown()
+
+    def test_gap_work_counts_toward_drain_quiescence(self):
+        """A pending gap keeps drain_resync_queue reporting progress so
+        settle loops don't exit before the relist ran."""
+        cluster, cache = make_cluster_cache()
+        cache._relist_min_interval = 0.0
+        cluster.remove_watch(cache._on_watch_event)
+        cluster.create_pod(make_pod("px"))
+        cluster.add_watch(cache._on_watch_event)
+        cluster.create_pod(make_pod("py"))
+        results = []
+        for _ in range(4):
+            results.append(cache.drain_resync_queue())
+            if results[-1] == 0:
+                break
+        assert cache.integrity_state()["relists"]["ok"] == 1
+        assert results[-1] == 0  # quiescent at the end
+        assert any(results), results
+        cache.shutdown()

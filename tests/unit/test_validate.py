@@ -1,0 +1,244 @@
+"""Post-solve placement validation (solver/validate.py) and its
+allocate_tpu ladder integration: a corrupted solver result must never
+reach bind dispatch — a device rung's rejection re-solves one rung
+down, the native floor drops the offenders."""
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.actions import allocate_tpu as atpu
+from kube_batch_tpu.api import PodPhase, build_resource_list
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.metrics import metrics as m
+from kube_batch_tpu.obs import RECORDER
+from kube_batch_tpu.solver import containment, tensorize
+from kube_batch_tpu.solver.validate import validate_placements
+from kube_batch_tpu.utils.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+from tests.actions.test_actions import (
+    DEFAULT_TIERS_ARGS,
+    make_cache,
+    make_tiers,
+    req,
+    run_action,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_containment():
+    containment.reset_breaker()
+    containment.set_device_fault_hook(None)
+    containment.set_result_tamper_hook(None)
+    containment.configure(None)
+    yield
+    containment.reset_breaker()
+    containment.set_device_fault_hook(None)
+    containment.set_result_tamper_hook(None)
+    containment.configure(None)
+
+
+def _pending_cluster(groups=3, pods=4, nodes=6):
+    c = make_cache()
+    c.add_queue(build_queue("default"))
+    for j in range(nodes):
+        c.add_node(build_node(
+            f"n{j}", build_resource_list(cpu="4", memory="8Gi")
+        ))
+    for g in range(groups):
+        c.add_pod_group(build_pod_group(
+            f"pg{g}", namespace="ns", min_member=1
+        ))
+        for i in range(pods):
+            c.add_pod(build_pod(
+                "ns", f"pg{g}-p{i}", "", PodPhase.PENDING, req(),
+                group_name=f"pg{g}",
+            ))
+    return c
+
+
+def _tensorized(cache):
+    ssn = open_session(cache, make_tiers(*DEFAULT_TIERS_ARGS))
+    inputs, ctx = tensorize(ssn, device=False)
+    return ssn, inputs, ctx
+
+
+class TestValidatePlacements:
+    def test_clean_assignment_passes(self):
+        c = _pending_cluster()
+        ssn, _inputs, ctx = _tensorized(c)
+        T, N = len(ctx.tasks), len(ctx.nodes)
+        a = (np.arange(T) % N).astype(np.int64)
+        bad, reasons = validate_placements(ctx, a)
+        assert bad.size == 0 and reasons == {}
+        close_session(ssn)
+        c.shutdown()
+
+    def test_bad_index_rejected(self):
+        c = _pending_cluster()
+        ssn, _inputs, ctx = _tensorized(c)
+        T, N = len(ctx.tasks), len(ctx.nodes)
+        a = (np.arange(T) % N).astype(np.int64)
+        a[3] = N + 7
+        a[5] = 2**30
+        bad, reasons = validate_placements(ctx, a)
+        assert sorted(bad.tolist()) == [3, 5]
+        assert reasons == {"bad-index": 2}
+        close_session(ssn)
+        c.shutdown()
+
+    def test_negative_bad_index_rejected(self):
+        """A corrupted NEGATIVE index (sign flip) is bad-index, not
+        'unplaced' — only the -1 sentinel means unassigned."""
+        c = _pending_cluster()
+        ssn, _inputs, ctx = _tensorized(c)
+        T, N = len(ctx.tasks), len(ctx.nodes)
+        a = (np.arange(T) % N).astype(np.int64)
+        a[2] = -7
+        a[4] = -1  # legitimate unassigned: never flagged
+        bad, reasons = validate_placements(ctx, a)
+        assert bad.tolist() == [2]
+        assert reasons == {"bad-index": 1}
+        close_session(ssn)
+        c.shutdown()
+
+    def test_infeasible_rejected(self):
+        c = _pending_cluster()
+        ssn, _inputs, ctx = _tensorized(c)
+        T, N = len(ctx.tasks), len(ctx.nodes)
+        a = np.full(T, -1, dtype=np.int64)
+        a[0] = 0
+        # Forge infeasibility: flip the mask's node_ok bit for node 0
+        # — the validator must see placement 0 violating the mask the
+        # solve was (supposedly) given.
+        ctx.mask.node_ok[0] = False
+        bad, reasons = validate_placements(ctx, a)
+        assert bad.tolist() == [0]
+        assert reasons == {"infeasible": 1}
+        close_session(ssn)
+        c.shutdown()
+
+    def test_gross_capacity_rejected(self):
+        c = _pending_cluster()
+        ssn, _inputs, ctx = _tensorized(c)
+        T = len(ctx.tasks)
+        # Every task piled on node 0: 12 x 1cpu vs 4 cpu allocatable —
+        # gross oversubscription far past epsilon slack.
+        a = np.zeros(T, dtype=np.int64)
+        bad, reasons = validate_placements(ctx, a)
+        assert reasons.get("capacity", 0) == T
+        assert bad.size == T
+        close_session(ssn)
+        c.shutdown()
+
+    def test_unassigned_vector_trivially_clean(self):
+        c = _pending_cluster()
+        ssn, _inputs, ctx = _tensorized(c)
+        a = np.full(len(ctx.tasks), -1, dtype=np.int64)
+        bad, reasons = validate_placements(ctx, a)
+        assert bad.size == 0 and reasons == {}
+        close_session(ssn)
+        c.shutdown()
+
+
+class TestLadderIntegration:
+    def test_corrupted_device_result_rejected_before_dispatch(
+        self, monkeypatch
+    ):
+        """The acceptance assert, end-to-end through the real action: a
+        tampered device result is rejected by validation BEFORE any
+        bind dispatches, the ladder descends one rung, and the cycle
+        completes with the trusted floor's placements only."""
+        monkeypatch.setenv("KBT_SOLVER", "jax")
+        monkeypatch.delenv("KBT_SOLVER_TOPK", raising=False)
+        tampers = []
+
+        def tamper(assigned):
+            # First device fetch only: rewrite two placements out of
+            # the node universe (a silent miscompute).
+            if tampers:
+                return assigned
+            tampers.append(1)
+            arr = np.array(assigned, copy=True)
+            sel = np.nonzero(np.asarray(arr) >= 0)[0]
+            arr[sel[:2]] = 2**30
+            return arr
+
+        containment.set_result_tamper_hook(tamper)
+        before = m.solver_output_rejected.get(("bad-index",))
+        before_fb = m.solver_fallback.get(("dense", "native", "rejected"))
+        RECORDER.begin_cycle()
+        c = _pending_cluster()
+        run_action(c, "allocate_tpu")
+        assert c.wait_for_side_effects()
+        rec = RECORDER.end_cycle()
+        # No bind ever targeted the corrupted out-of-universe "node",
+        # and every task still placed (via the floor).
+        assert len(c.binder.binds) == 12
+        assert all(host.startswith("n") for host in c.binder.binds.values())
+        ladder = atpu.last_stats["solve_ladder"]
+        assert [(e["rung"], e["outcome"]) for e in ladder] == [
+            ("dense", "rejected"), ("native", "ok"),
+        ]
+        assert ladder[0]["reasons"] == {"bad-index": 2}
+        assert atpu.last_stats["validation_rejected"] == 2
+        assert atpu.last_stats["solve_degraded"] is True
+        assert rec["solver"]["ladder"] == ladder
+        assert m.solver_output_rejected.get(("bad-index",)) == before + 2
+        assert m.solver_fallback.get(
+            ("dense", "native", "rejected")
+        ) == before_fb + 1
+        assert containment.last_fallback["reason"] == "rejected"
+        c.shutdown()
+
+    def test_rejection_feeds_breaker(self, monkeypatch):
+        monkeypatch.setenv("KBT_SOLVER", "jax")
+        monkeypatch.delenv("KBT_SOLVER_TOPK", raising=False)
+
+        def tamper(assigned):
+            arr = np.array(assigned, copy=True)
+            sel = np.nonzero(np.asarray(arr) >= 0)[0]
+            if sel.size:
+                arr[sel[:1]] = 2**30
+            return arr
+
+        containment.set_result_tamper_hook(tamper)
+        c = _pending_cluster()
+        run_action(c, "allocate_tpu")
+        assert c.wait_for_side_effects()
+        assert containment.BREAKER.failure_streak >= 1
+        c.shutdown()
+
+    def test_native_floor_drops_offenders(self, monkeypatch):
+        """A native-floor validation failure (nothing below it) drops
+        the offending placements and dispatches the rest."""
+        monkeypatch.setenv("KBT_SOLVER", "native")
+        c = _pending_cluster()
+        orig = validate_placements
+
+        calls = []
+
+        def fake_validate(ctx, assigned):
+            bad, reasons = orig(ctx, assigned)
+            if not calls:
+                calls.append(1)
+                sel = np.nonzero(np.asarray(assigned)[: len(ctx.tasks)]
+                                 >= 0)[0]
+                return sel[:2], {"infeasible": 2}
+            return bad, reasons
+
+        monkeypatch.setattr(
+            "kube_batch_tpu.solver.validate.validate_placements",
+            fake_validate,
+        )
+        run_action(c, "allocate_tpu")
+        assert c.wait_for_side_effects()
+        ladder = atpu.last_stats["solve_ladder"]
+        assert ladder[0]["outcome"] == "rejected-dropped"
+        assert ladder[0]["rejected"] == 2
+        assert len(c.binder.binds) == 10  # 12 minus the 2 dropped
+        c.shutdown()

@@ -114,6 +114,17 @@ POLICY: List[Tuple[str, str, float, str]] = [
     ("recovery.reconcile_ms", "lower", 0.35, "single"),
     ("recovery.first_cycle_ms", "lower", 0.35, "single"),
     ("recovery.takeover_ms", "lower", 0.35, "single"),
+    # Cluster-truth anti-entropy + post-solve validation (PR 15):
+    # steady-sweep and validation costs are the per-cycle-budget
+    # numbers (the <1%-of-steady pin is quoted off them); the divergent
+    # sweep is single-shot repair work; detected==repaired is exact
+    # (fixed seed injects a fixed divergence set).
+    ("integrity.sweep_steady_ms", "lower", 0.35, "med"),
+    ("integrity.sweep_churned_ms", "lower", 0.35, "med"),
+    ("integrity.sweep_divergent_ms", "lower", 0.50, "single"),
+    ("integrity.validation_ms", "lower", 0.35, "med"),
+    ("integrity.divergence_detected", "count", 0.0, "exact"),
+    ("integrity.divergence_repaired", "count", 0.0, "exact"),
     ("vs_baseline", "higher", 0.25, "ratio"),
     ("pods_placed_per_sec", "higher", 0.25, "min3"),
     ("sim.cycles_per_sec", "higher", 0.35, "med"),
