@@ -125,7 +125,8 @@ shard-smoke:
 		$(PY) -m kube_batch_tpu sim --host-devices 4 \
 		--replay /tmp/kbt_shard_smoke.jsonl \
 		--backend sparse --topk 8 \
-		--require-sparse-sharded --fail-on-cycle-errors --quiet
+		--require-sparse-sharded --require-device-selection \
+		--fail-on-cycle-errors --quiet
 
 # Failover kill drill: the leader is hard-stopped at EVERY seeded cut
 # point (pre-solve / post-solve-pre-drain / mid-bind-drain / mid-close,

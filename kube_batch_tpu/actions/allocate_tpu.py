@@ -850,6 +850,23 @@ class AllocateTpuAction(Action):
                     handle.reconcile_rounds
                 )
             metrics.register_sparse_sharded(disp.get("mode"))
+            # Delta-packed commit accounting (spmd.note_commit_stats):
+            # per-round wire bytes of the code+accept-bit exchange vs
+            # the full-state broadcast it replaced.
+            from ..solver import spmd as spmd_mod
+
+            for key in (
+                "commit_bytes_exchanged",
+                "commit_bytes_full_broadcast",
+                "commit_bytes_per_round",
+            ):
+                if key in spmd_mod.last_commit_stats:
+                    last_stats[key] = spmd_mod.last_commit_stats[key]
+        # Which path produced the candidate slabs (device-resident
+        # selection vs labeled host fallback) — tensorize stats carry
+        # the label; the device counter is incremented at the source.
+        if tsparse.get("select_path"):
+            last_stats["select_path"] = tsparse.get("select_path")
         try:
             from ..solver.kernels import jit_compilation_count
 

@@ -416,6 +416,15 @@ solver_sparse_sharded = REGISTRY.register(
     ),
     ("mode",),
 )
+solver_selection_device = REGISTRY.register(
+    Counter(
+        "solver_selection_device_total",
+        "Selection passes whose per-class scoring and top-K extraction "
+        "ran on the device-resident key matrix "
+        "(solver/select_device.py; host fallbacks are labeled in "
+        "tensorize stats, not here)",
+    )
+)
 # Scheduling-loop robustness + simulator counters (the long-horizon
 # harness in kube_batch_tpu/sim must be observable like everything
 # else: a fault run that silently stops injecting, or an invariant
@@ -747,6 +756,11 @@ def register_warm_start(outcome: str) -> None:
 
 def register_micro_cycle(outcome: str) -> None:
     scheduler_micro_cycles.inc((outcome,))
+
+
+def register_device_selection() -> None:
+    """One selection pass ran on the device-resident key matrix."""
+    solver_selection_device.inc()
 
 
 def update_device_cache(stats: dict) -> None:

@@ -11,7 +11,9 @@ incomplete (``--require-kill-cuts`` — a required leader-kill cut never
 fired, or a successor recovery pass reported errors); 7 the
 divergence-repair assert failed (``--require-divergence-repaired`` —
 a divergence was left unrepaired at run end, or the run injected no
-event/solver-corrupt faults at all and proved nothing).
+event/solver-corrupt faults at all and proved nothing); 8 the
+device-selection assert failed (``--require-device-selection`` — no
+selection pass ran on the device-resident key matrix).
 """
 
 from __future__ import annotations
@@ -144,6 +146,11 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
         help="exit 5 unless at least one cycle's sparse solve ran "
              "sharded over the device mesh "
              "(solver_sparse_sharded_solves_total)")
+    parser.add_argument(
+        "--require-device-selection", action="store_true",
+        help="exit 8 unless at least one selection pass ran on the "
+             "device-resident key matrix "
+             "(solver_selection_device_total)")
     parser.add_argument("--quiet", action="store_true",
                         help="suppress the JSON report on stdout")
 
@@ -251,6 +258,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         sharded_solves = int(metrics.solver_sparse_sharded.total())
         out["sparse_sharded_solves"] = sharded_solves
+    device_selections = None
+    if ns.require_device_selection:
+        from .. import metrics
+
+        device_selections = int(metrics.solver_selection_device.total())
+        out["device_selections"] = device_selections
     if ns.report_out:
         with open(ns.report_out, "w") as f:
             json.dump(out, f, indent=2, sort_keys=True)
@@ -293,6 +306,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 5
+    if ns.require_device_selection and not device_selections:
+        print(
+            "sim: no selection pass ran on the device-resident key "
+            "matrix (--require-device-selection)",
+            file=sys.stderr,
+        )
+        return 8
     if ns.require_kill_cuts:
         from .failover import CUT_POINTS
 

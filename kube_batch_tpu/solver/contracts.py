@@ -128,6 +128,23 @@ DENSE_SPMD_SHARD_DIMS = {
 # [T, K, R] idle gathers) inside the shard_map body, which is where
 # the memory that grows with T·K actually lives.
 SPARSE_SHARD_DIMS = {}
+# Two-level rack decomposition (solver/spmd.py two_level=True): the
+# per-rack phase gives each shard exclusive WRITE ownership of one
+# N/s node block along these fields' node axis — which block comes
+# from sharding.rack_perm's topology-aligned shard→rack map
+# (slice/ICI coordinates when the backend exposes them, contiguous
+# identity otherwise). The values stay replicated on the mesh (the
+# psum reconcile depends on it); this table declares the logical
+# ownership split so a field rename/reshape breaks loudly in kbtlint
+# rather than silently double-committing a node block.
+TWO_LEVEL_RACK_DIMS = {
+    "node_feas": 0,
+    "node_idle": 0,
+    "node_releasing": 0,
+    "node_cap": 0,
+    "node_task_count": 0,
+    "node_max_tasks": 0,
+}
 
 CHECK_CONTRACTS_ENV = "KBT_CHECK_CONTRACTS"
 

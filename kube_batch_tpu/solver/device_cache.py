@@ -140,13 +140,16 @@ class DeviceSnapshotCache:
     attribute), giving it exactly the lifetime of the mirror it
     shadows — same pattern as ``snapshot._TensorizeCache``."""
 
-    __slots__ = ("host", "dev", "layout_token", "placement")
+    __slots__ = ("host", "dev", "layout_token", "placement", "_deferred")
 
     def __init__(self):
         # field -> exact host copy of what is resident on device
         self.host: Dict[str, np.ndarray] = {}
         # field -> jax.Array resident buffer
         self.dev: Dict[str, object] = {}
+        # Stats of a pack_partial() awaiting merge into the next pack()
+        # (the two are one logical pack per cycle).
+        self._deferred: Optional[dict] = None
         # Solver device-layout key (sharding.packed_sparse_placement):
         # resident buffers are only reusable under the layout they were
         # placed for — a mesh/mode flip voids them all (labeled
@@ -220,6 +223,79 @@ class DeviceSnapshotCache:
         stats["field_outcomes"][name] = "patch"
         return dev
 
+    def _pack_field(self, name: str, arr: np.ndarray,
+                    cold_reason: str, stats: dict):
+        """Reuse/patch/upload decision for ONE stacked field (shared by
+        :meth:`pack` and :meth:`pack_partial`)."""
+        cached = self.host.get(name)
+        dev = self.dev.get(name)
+        if cached is None or dev is None:
+            return self._upload(name, arr, cold_reason, stats)
+        if cached.shape != arr.shape or cached.dtype != arr.dtype:
+            return self._upload(name, arr, "shape-change", stats)
+        rows, nrows = self._diff_rows(name, arr, cached)
+        if rows.size == 0:
+            stats["reuses"] += 1
+            stats["field_outcomes"][name] = "reuse"
+            return dev
+        if arr.nbytes < _MIN_PATCH_BYTES:
+            return self._upload(name, arr, "small-buffer", stats)
+        if rows.size * _BULK_DIRTY_DEN > nrows:
+            return self._upload(name, arr, "bulk-dirty", stats)
+        return self._patch(name, arr, rows, stats)
+
+    def _empty_stats(self) -> dict:
+        return {
+            "reuses": 0,
+            "patches": 0,
+            "uploads": 0,
+            "rows_patched": 0,
+            "bytes_shipped": 0,
+            "slab_bytes_shipped": 0,
+            "bytes_total": 0,
+            "full_reasons": {},
+            "field_outcomes": {},
+        }
+
+    def _enter_layout(self, placement, layout_token, stats) -> str:
+        cold_reason = "cold"
+        if layout_token != self.layout_token:
+            if self.host:
+                self.drop()
+                cold_reason = "mesh-change"
+                stats["layout_change"] = True
+            self.layout_token = layout_token
+        self.placement = placement
+        return cold_reason
+
+    def pack_partial(self, arrays: Dict[str, np.ndarray],
+                     placement: Optional[object] = None,
+                     layout_token: Optional[str] = None) -> Dict[str, object]:
+        """Place a SUBSET of the cycle's stacked fields on device ahead
+        of the full :meth:`pack` — the device-resident selection pass
+        (solver/select_device.py) needs the node stacks and group rows
+        resident BEFORE the candidate slabs it produces can exist. The
+        later pack() sees bit-identical host arrays and reuses these
+        buffers; the traffic stats here are deferred and merged into
+        that pack()'s ledger so per-cycle accounting stays whole."""
+        stats = self._empty_stats()
+        cold_reason = self._enter_layout(placement, layout_token, stats)
+        out = {
+            name: self._pack_field(name, arr, cold_reason, stats)
+            for name, arr in arrays.items()
+        }
+        if self._deferred is None:
+            self._deferred = stats
+        else:  # two partials before a pack: fold counters forward
+            for key in ("patches", "uploads", "rows_patched",
+                        "bytes_shipped"):
+                self._deferred[key] += stats[key]
+            self._deferred["full_reasons"].update(stats["full_reasons"])
+            self._deferred["field_outcomes"].update(
+                stats["field_outcomes"]
+            )
+        return out
+
     def pack(self, arrays: Dict[str, np.ndarray],
              placement: Optional[object] = None,
              layout_token: Optional[str] = None):
@@ -243,57 +319,35 @@ class DeviceSnapshotCache:
             # dims bound across fields (KBT_CHECK_CONTRACTS=1).
             validate_packed(arrays, where="device_cache.pack")
 
-        stats = {
-            "reuses": 0,
-            "patches": 0,
-            "uploads": 0,
-            "rows_patched": 0,
-            "bytes_shipped": 0,
-            "slab_bytes_shipped": 0,
-            "bytes_total": 0,
-            "full_reasons": {},
-            "field_outcomes": {},
-        }
-        cold_reason = "cold"
-        if layout_token != self.layout_token:
-            if self.host:
-                self.drop()
-                cold_reason = "mesh-change"
-                stats["layout_change"] = True
-            self.layout_token = layout_token
-        self.placement = placement
+        stats = self._empty_stats()
+        cold_reason = self._enter_layout(placement, layout_token, stats)
         fields: Dict[str, object] = {}
         for name, arr in arrays.items():
             stats["bytes_total"] += arr.nbytes
             shipped_before = stats["bytes_shipped"]
-            cached = self.host.get(name)
-            dev = self.dev.get(name)
-            if cached is None or dev is None:
-                fields[name] = self._upload(name, arr, cold_reason, stats)
-            elif cached.shape != arr.shape or cached.dtype != arr.dtype:
-                fields[name] = self._upload(
-                    name, arr, "shape-change", stats
-                )
-            else:
-                rows, nrows = self._diff_rows(name, arr, cached)
-                if rows.size == 0:
-                    fields[name] = dev
-                    stats["reuses"] += 1
-                    stats["field_outcomes"][name] = "reuse"
-                elif arr.nbytes < _MIN_PATCH_BYTES:
-                    fields[name] = self._upload(
-                        name, arr, "small-buffer", stats
-                    )
-                elif rows.size * _BULK_DIRTY_DEN > nrows:
-                    fields[name] = self._upload(
-                        name, arr, "bulk-dirty", stats
-                    )
-                else:
-                    fields[name] = self._patch(name, arr, rows, stats)
+            fields[name] = self._pack_field(name, arr, cold_reason, stats)
             if name.startswith("cand"):
                 stats["slab_bytes_shipped"] += (
                     stats["bytes_shipped"] - shipped_before
                 )
+
+        # Fold in a preceding pack_partial (same cycle, same logical
+        # pack): its uploads/patches are real traffic; a field it
+        # already placed shows as "reuse" above, so surface the partial
+        # outcome instead for forensics.
+        deferred, self._deferred = self._deferred, None
+        if deferred is not None:
+            for key in ("patches", "uploads", "rows_patched",
+                        "bytes_shipped"):
+                stats[key] += deferred[key]
+            stats["full_reasons"].update(deferred["full_reasons"])
+            for f, outcome in deferred["field_outcomes"].items():
+                if outcome != "reuse":
+                    if stats["field_outcomes"].get(f) == "reuse":
+                        stats["reuses"] -= 1
+                    stats["field_outcomes"][f] = outcome
+            if deferred.get("layout_change"):
+                stats["layout_change"] = True
 
         last_pack_stats.clear()
         last_pack_stats.update(stats)

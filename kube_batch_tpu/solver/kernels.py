@@ -403,19 +403,17 @@ def dynamic_scores(
     )
 
 
-def _commit_bids(
-    bid, assigned, idle, ntask, qalloc,
+def _resolve_bids(
+    bid, idle, ntask, qalloc,
     *, task_req, task_fit, task_rank, task_queue,
     node_max_tasks, queue_deserved, eps,
 ):
-    """One conflict-resolution + commit step shared by the solver stages:
-    given each task's bid (node index, N = no bid), accept bidders per
-    node in priority order while they fit (segmented prefix sums), then
-    enforce per-queue budgets, then apply accepted requests to node idle /
-    task counts / queue allocations. Task arrays may be a compacted subset
-    of the session (the staged tail); ranks are global values.
-
-    Returns (assigned, idle, ntask, qalloc, any_accept).
+    """Conflict resolution only: given each task's bid (node index, N =
+    no bid), accept bidders per node in priority order while they fit
+    (segmented prefix sums), then enforce per-queue budgets. Returns the
+    accept mask in TASK order ([T] bool) so any consumer — the local
+    solve or a remote shard receiving a broadcast mask — can apply it
+    through :func:`_apply_accepts` with bit-identical arithmetic.
     """
     T, R = task_req.shape
     N = idle.shape[0]
@@ -477,18 +475,63 @@ def _commit_bids(
     accept = jnp.zeros_like(accept).at[qorder].set(
         accept[qorder] & budget_ok
     )
+    # Scatter the sorted-space accepts back to task order: the state
+    # update below (and every shard of the delta-packed commit) sums
+    # floats in TASK order, so one canonical ordering keeps all paths
+    # bit-identical.
+    return jnp.zeros((T,), bool).at[order].set(accept)
 
-    delta = jnp.where(accept[:, None], sreq, 0.0)
+
+def _apply_accepts(
+    accept, bid, assigned, idle, ntask, qalloc,
+    *, task_req, task_queue,
+):
+    """Apply a task-order accept mask to the solver state. All float
+    reductions run in task order via segment_sum, so a single device and
+    every shard replaying the same (accept, bid) pair land on
+    bit-identical idle/qalloc — the invariant the delta-packed commit
+    collective (spmd.py) relies on.
+
+    Returns (assigned, idle, ntask, qalloc).
+    """
+    N = idle.shape[0]
+    Q = qalloc.shape[0]
+    sbid = jnp.where(accept, bid, N)
+    delta = jnp.where(accept[:, None], task_req, 0.0)
     idle = idle - jax.ops.segment_sum(delta, sbid, num_segments=N + 1)[:N]
     ntask = ntask + jax.ops.segment_sum(
         accept.astype(jnp.int32), sbid, num_segments=N + 1
     )[:N]
-    q_ids = jnp.where(accept, squeue, Q)
+    q_ids = jnp.where(accept, task_queue, Q)
     qalloc = qalloc + jax.ops.segment_sum(
         delta, q_ids, num_segments=Q + 1
     )[:Q]
-    assigned = assigned.at[order].set(
-        jnp.where(accept, sbid, assigned[order])
+    assigned = jnp.where(accept, sbid, assigned)
+    return assigned, idle, ntask, qalloc
+
+
+def _commit_bids(
+    bid, assigned, idle, ntask, qalloc,
+    *, task_req, task_fit, task_rank, task_queue,
+    node_max_tasks, queue_deserved, eps,
+):
+    """One conflict-resolution + commit step shared by the solver stages
+    (:func:`_resolve_bids` then :func:`_apply_accepts`). Task arrays may
+    be a compacted subset of the session (the staged tail); ranks are
+    global values.
+
+    Returns (assigned, idle, ntask, qalloc, any_accept).
+    """
+    accept = _resolve_bids(
+        bid, idle, ntask, qalloc,
+        task_req=task_req, task_fit=task_fit,
+        task_rank=task_rank, task_queue=task_queue,
+        node_max_tasks=node_max_tasks,
+        queue_deserved=queue_deserved, eps=eps,
+    )
+    assigned, idle, ntask, qalloc = _apply_accepts(
+        accept, bid, assigned, idle, ntask, qalloc,
+        task_req=task_req, task_queue=task_queue,
     )
     return assigned, idle, ntask, qalloc, jnp.any(accept)
 
@@ -1367,6 +1410,7 @@ def jit_compilation_count() -> int:
     metrics.solver_jit_compilations)."""
     from . import sharding, spmd
     from .device_cache import patch_jit_cache_size
+    from .select_device import jit_cache_size as select_jit_cache_size
 
     total = 0
     fns = [solve_jit, solve_full_jit, solve_staged_jit, solve_sparse_jit]
@@ -1379,4 +1423,4 @@ def jit_compilation_count() -> int:
             total += fn._cache_size()
         except Exception:  # pragma: no cover - private-API drift
             pass
-    return total + patch_jit_cache_size()
+    return total + patch_jit_cache_size() + select_jit_cache_size()

@@ -245,12 +245,57 @@ _TWO_LEVEL_MIN_TASKS = 1 << 19
 # like device_cache.last_pack_stats.
 last_dispatch: dict = {}
 
-# Device count witnessed by the first sharded dispatch — process
-# -constant once set (a jax process cannot change its device set), and
-# deliberately NEVER probed outside a solve path: jax.devices() on a
-# wedged tunnel can hang, and warm-plan/native paths must not take
-# that risk (see prospective_layout_token).
-_layout_state: dict = {"devices": None}
+# Device count + rack-map digest witnessed by the first sharded
+# dispatch — process-constant once set (a jax process cannot change its
+# device set), and deliberately NEVER probed outside a solve path:
+# jax.devices() on a wedged tunnel can hang, and warm-plan/native paths
+# must not take that risk (see prospective_layout_token).
+_layout_state: dict = {"devices": None, "rack": None}
+
+
+def rack_perm(mesh: Mesh) -> np.ndarray:
+    """Topology-aligned shard→rack map for the two-level solve:
+    ``rack_perm(mesh)[shard]`` is the rack (node block) shard ``shard``
+    owns. Backends that expose physical placement (TPU: ``slice_index``
+    + ICI ``coords``) get racks ordered by (slice, coords) so each rack
+    block lands on physically adjacent chips (Tesserae-style); backends
+    without coordinates (CPU meshes, older runtimes) fall back to the
+    contiguous identity map, which is exactly the pre-topology
+    behavior."""
+    devs = list(np.asarray(mesh.devices).flat)
+    keys = []
+    for d in devs:
+        coords = getattr(d, "coords", None)
+        if coords is None:
+            return np.arange(len(devs), dtype=np.int32)
+        slice_idx = getattr(d, "slice_index", None)
+        keys.append((
+            slice_idx if slice_idx is not None else 0, tuple(coords),
+        ))
+    order = sorted(range(len(devs)), key=lambda i: keys[i])
+    perm = np.empty(len(devs), dtype=np.int32)
+    for rack, shard in enumerate(order):
+        perm[shard] = rack
+    return perm
+
+
+def rack_digest(mesh: Optional[Mesh] = None) -> Optional[str]:
+    """Short content token of the mesh's rack map, carried in the
+    layout tokens so BOTH the warm-start plan and the selection caches
+    invalidate when the node→rack decomposition moves (a topology-
+    aligned split reshuffles which node block each shard owns). The
+    contiguous identity map hashes to a stable ``c<n>`` token; None
+    when no mesh exists."""
+    if mesh is None:
+        mesh = default_mesh()
+    if mesh is None:
+        return None
+    perm = rack_perm(mesh)
+    if np.array_equal(perm, np.arange(len(perm), dtype=np.int32)):
+        return f"c{len(perm)}"
+    import hashlib
+
+    return hashlib.blake2b(perm.tobytes(), digest_size=4).hexdigest()
 
 
 def sparse_shard_mode(n_tasks: int, mesh: Optional[Mesh]) -> str:
@@ -287,7 +332,12 @@ def prospective_layout_token() -> Optional[str]:
     if n is None:
         return None
     mode = os.environ.get("KBT_SPARSE_SHARD_MODE", "").strip().lower()
-    return f"{n}dev:{mode or 'auto'}"
+    token = f"{n}dev:{mode or 'auto'}"
+    rack = _layout_state.get("rack")
+    # Rack suffix only when the dispatch pinned a rack map — tokens
+    # from pre-topology processes (saved warm states) keep comparing
+    # equal to themselves.
+    return f"{token}:{rack}" if rack else token
 
 
 def packed_sparse_placement(n_tasks: int) -> Tuple[Optional[NamedSharding], str]:
@@ -302,6 +352,12 @@ def packed_sparse_placement(n_tasks: int) -> Tuple[Optional[NamedSharding], str]
     size = mesh.size if mesh is not None else 1
     mode = sparse_shard_mode(n_tasks, mesh) if n_tasks else "single"
     token = f"{size}dev:{mode}"
+    rack = rack_digest(mesh)
+    if rack:
+        # Rack-map changes must re-key device residency: a moved
+        # node→rack split invalidates resident selection keys and the
+        # packed buffers' layout assumptions together.
+        token = f"{token}:{rack}"
     if mesh is None or mode == "single":
         return None, token
     return NamedSharding(mesh, P()), token
@@ -376,10 +432,12 @@ def _note_dispatch(mode: str, shards: int, reason: str = None) -> None:
     )
     if reason:
         last_dispatch["reason"] = reason
-    # First dispatch pins the process's device count for the warm
-    # plan's layout token (jax is live here by definition).
+    # First dispatch pins the process's device count + rack-map digest
+    # for the warm plan's layout token (jax is live here by
+    # definition).
     if _layout_state["devices"] is None:
         _layout_state["devices"] = jax.device_count()
+        _layout_state["rack"] = rack_digest()
 
 
 def _sparse_sharded_step(inputs, mesh: Mesh, mode: str, max_rounds,
@@ -387,8 +445,13 @@ def _sparse_sharded_step(inputs, mesh: Mesh, mode: str, max_rounds,
     """(step, device_inputs) for the task-sharded sparse solve: pad
     the task axis (and node axis for two-level) to the mesh multiple,
     device_put replicated, hand back the cached jitted step."""
-    from .spmd import _spmd_sparse_step, sparse_spmd_shardings_for
+    from .spmd import (
+        _spmd_sparse_step,
+        note_commit_stats,
+        sparse_spmd_shardings_for,
+    )
 
+    note_commit_stats(inputs)
     if not isinstance(inputs, PackedInputs):
         inputs = pad_tasks(inputs, mesh.size)
         if mode == "two-level":

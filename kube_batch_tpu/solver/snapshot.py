@@ -984,16 +984,83 @@ def tensorize(
     lr_w = float(weights.get("leastrequested", 0.0))
     br_w = float(weights.get("balancedresource", 0.0))
 
+    # --- shape buckets + early node-stack placement -----------------------
+    # Bucketed axis sizes are needed BEFORE selection now: the
+    # device-resident selection pass (solver/select_device.py) reads the
+    # padded node stacks and group rows off the device cache, so those
+    # fields are packed ahead of the slabs they help produce. The later
+    # full pack sees bit-identical arrays and reuses them.
+    Tp = _task_bucket(T) if pad else T
+    Np = _round_up(N, 128) if pad else N
+
+    def pad_rows(a, rows, fill=0):
+        if rows == a.shape[0]:
+            return a
+        out = np.full((rows,) + a.shape[1:], fill, dtype=a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    node_feas_p = pad_rows(mask.node_ok, Np, fill=False)
+    # Pad both axes of the group rows: nodes to Np, and the group count
+    # to a power of two (all-False rows no task references) so the
+    # signature mix churning cycle-to-cycle does not re-jit the solver.
+    group_feas = np.ascontiguousarray(
+        pad_rows(mask.group_rows.T, Np, fill=False).T
+    )
+    Gp = max(1, _pow2(group_feas.shape[0])) if pad else group_feas.shape[0]
+    group_feas = pad_rows(group_feas, Gp, fill=False)
+    node_f32_stack = np.stack([
+        pad_rows(node_idle, Np), pad_rows(node_releasing, Np),
+        pad_rows(node_cap, Np),
+    ])
+    node_i32_stack = np.stack([
+        pad_rows(node_task_count, Np), pad_rows(node_max_tasks, Np),
+        node_feas_p.astype(np.int32),
+    ])
+
     # --- top-K candidate selection (solver/topk.py) -----------------------
     # Phase 1 of the sparse solve: dedup tasks into candidate classes
     # and keep each class's top-K nodes by the fused feasibility +
-    # initial-idle score pass. Runs on the UNPADDED arrays; the slabs
-    # are padded/bucketed below with everything else.
+    # initial-idle score pass. Runs against the UNPADDED node arrays
+    # (host fallback) or the padded resident stacks (device path); the
+    # slabs are padded/bucketed below with everything else.
     from .topk import select_candidates, topk_config
 
     tk = topk_config(T, N)
     cand_sel = None
     sparse_reason = tk.reason
+    device_state = None
+    if device and tk.enabled:
+        from .device_cache import device_cache_of
+        from .select_device import (
+            SelectionDeviceState,
+            device_select_enabled,
+        )
+        from .sharding import packed_sparse_placement
+
+        dc0 = device_cache_of(ssn.cache)
+        if (
+            dc0 is not None
+            and device_select_enabled()
+            and not bool(node_rel64.any())
+        ):
+            try:
+                placement0, token0 = packed_sparse_placement(Tp)
+                placed = dc0.pack_partial(
+                    {
+                        "node_f32": node_f32_stack,
+                        "node_i32": node_i32_stack,
+                        "group_feas": group_feas,
+                    },
+                    placement=placement0, layout_token=token0,
+                )
+                device_state = SelectionDeviceState(
+                    ssn.cache, placed["node_f32"], placed["node_i32"],
+                    placed["group_feas"], Np, token0,
+                )
+            except Exception:  # pragma: no cover - fall back to host
+                logger.exception("device-selection pre-pack failed")
+                device_state = None
     if tk.enabled:
         with _span("topk_select", k=tk.k):
             cand_sel = select_candidates(
@@ -1007,6 +1074,7 @@ def tensorize(
                     if scan is not None and scan.nodes is nodes
                     else None
                 ),
+                device_state=device_state,
             )
         if cand_sel is None:
             sparse_reason = "class-budget"
@@ -1031,18 +1099,9 @@ def tensorize(
         queue_deserved[queue_index[q.uid]] = layout.vec(deserved)
         queue_allocated[queue_index[q.uid]] = layout.vec(allocated)
 
-    # --- padding to shape buckets -----------------------------------------
-    Tp = _task_bucket(T) if pad else T
-    Np = _round_up(N, 128) if pad else N
+    # --- padding to shape buckets (Tp/Np/pad_rows hoisted above) ----------
     task_valid = np.zeros(Tp, dtype=bool)
     task_valid[:T] = True
-
-    def pad_rows(a, rows, fill=0):
-        if rows == a.shape[0]:
-            return a
-        out = np.full((rows,) + a.shape[1:], fill, dtype=a.dtype)
-        out[: a.shape[0]] = a
-        return out
 
     task_req = pad_rows(task_req, Tp)
     task_fit = pad_rows(task_fit, Tp)
@@ -1054,20 +1113,16 @@ def tensorize(
         [task_job, np.arange(T, Tp, dtype=np.int32)]
     )
     task_group = pad_rows(mask.task_group, Tp)
-    node_feas = pad_rows(mask.node_ok, Np, fill=False)
-    # Pad both axes of the group rows: nodes to Np, and the group count to
-    # a power of two (all-False rows no task references) so the signature
-    # mix churning cycle-to-cycle does not re-jit the solver.
-    group_feas = np.ascontiguousarray(
-        pad_rows(mask.group_rows.T, Np, fill=False).T
-    )
-    Gp = max(1, _pow2(group_feas.shape[0])) if pad else group_feas.shape[0]
-    group_feas = pad_rows(group_feas, Gp, fill=False)
-    node_idle = pad_rows(node_idle, Np)
-    node_releasing = pad_rows(node_releasing, Np)
-    node_cap = pad_rows(node_cap, Np)
-    node_task_count = pad_rows(node_task_count, Np)
-    node_max_tasks = pad_rows(node_max_tasks, Np)
+    # Padded node tables were built above (early node-stack placement);
+    # unpack the stacks so host_inputs and the packed buffers are views
+    # of the SAME arrays (bit-identity keeps the device cache's reuse
+    # fast path exact).
+    node_feas = node_feas_p
+    node_idle = node_f32_stack[0]
+    node_releasing = node_f32_stack[1]
+    node_cap = node_f32_stack[2]
+    node_task_count = node_i32_stack[0]
+    node_max_tasks = node_i32_stack[1]
 
     P = len(mask.pair_idx)
     Pp = _pow2(P) if pad else P
@@ -1167,10 +1222,8 @@ def tensorize(
             task_rank, task_queue, task_job, task_group,
             task_valid.astype(np.int32), task_cand,
         ]),
-        "node_f32": np.stack([node_idle, node_releasing, node_cap]),
-        "node_i32": np.stack([
-            node_task_count, node_max_tasks, node_feas.astype(np.int32),
-        ]),
+        "node_f32": node_f32_stack,
+        "node_i32": node_i32_stack,
         "group_feas": group_feas,
         "pair_idx": pair_idx,
         "pair_feas": pair_feas,

@@ -67,6 +67,16 @@ DEFAULT_K = 64
 # replace, so the policy falls back to dense past this budget.
 _CLASS_BUDGET_FACTOR = 4
 
+# Deterministic top-K tie rule, shared with the device path
+# (solver/select_device.py): larger key first, equal keys -> smaller
+# node id. The host realizes it by partitioning on an int64 composite
+# ``(skey << 31) + (2^31-1 - node_id)`` (skey tops out below 2^30, so
+# the composite never overflows and ineligible rows stay negative);
+# the device gets the identical rule for free from ``lax.top_k``'s
+# lower-index-first preference. Without this, argpartition's choice at
+# the k-th boundary was unspecified on quantized-score ties.
+_TIE_BITS = 31
+
 
 @dataclass(frozen=True)
 class TopKConfig:
@@ -122,6 +132,19 @@ class CandidateSet:
     cand_static: np.ndarray  # f32[C, K] static score slab
     cand_info: np.ndarray    # i32[3, C] total / any_feas / fits_releasing
     stats: dict
+
+
+def _layout_sig_token():
+    """Solver layout token folded into the selection-cache signatures
+    (host AND device): a mesh/mode/rack-map change reshuffles which
+    node block each shard owns, so carried key rows must invalidate
+    with the same ``mesh-changed`` semantics as the warm plan."""
+    try:
+        from .sharding import prospective_layout_token
+
+        return prospective_layout_token()
+    except Exception:  # pragma: no cover - sharding import must not kill
+        return None
 
 
 def _sel_hash(c_ids: np.ndarray, n_ids: np.ndarray) -> np.ndarray:
@@ -190,7 +213,8 @@ class _SelectionCache:
     full computation, so cached and fresh selections are bit-identical
     by construction."""
 
-    __slots__ = ("sig", "node_objs", "node_ids", "node_vers", "rows")
+    __slots__ = ("sig", "node_objs", "node_ids", "node_vers", "rows",
+                 "dedup_key", "dedup")
 
     def __init__(self):
         self.sig = None
@@ -204,6 +228,15 @@ class _SelectionCache:
         self.node_ids = None
         self.node_vers = None
         self.rows: Dict[tuple, np.ndarray] = {}
+        # Content-addressed class dedup: digest of the [T, 2+2R] key
+        # matrix -> its np.unique decomposition. The lexsort behind
+        # np.unique(axis=0) is O(T log T) over 6 columns (seconds at
+        # 1M tasks) while a steady cycle's task CONTENT rarely moves —
+        # node churn never touches it. An exact digest hit replays the
+        # identical (rep_idx, task_cand); any content change misses to
+        # the full unique.
+        self.dedup_key = None
+        self.dedup = None
 
 
 def _sel_cache_of(holder) -> Optional[_SelectionCache]:
@@ -271,7 +304,7 @@ def _skey_priv_row(req_row, fit_row, class_id,
 
 
 def select_candidates(
-    mask,                         # masks.CombinedMask (unpadded)
+    mask: "CombinedMask",         # masks.CombinedMask (unpadded)
     score_rows_map: Dict[int, np.ndarray],
     task_req: np.ndarray,         # f32[T, R] rank-ordered
     task_fit: np.ndarray,         # f32[T, R]
@@ -284,8 +317,11 @@ def select_candidates(
     lr_weight: float,
     br_weight: float,
     k: int,
-    cache_holder=None,
-    node_fp=None,     # (ids i64[N], vers i64[N], [NodeInfo] pins) or None
+    cache_holder: Optional[object] = None,
+    # (ids i64[N], vers i64[N], [NodeInfo] pins) or None
+    node_fp: Optional[tuple] = None,
+    # select_device.SelectionDeviceState or None
+    device_state: Optional["SelectionDeviceState"] = None,
 ) -> Optional[CandidateSet]:
     """Run the fused feasibility + static-score selection pass.
 
@@ -310,11 +346,23 @@ def select_candidates(
         task_req.astype(np.float32),
         task_fit.astype(np.float32),
     ])
-    _, rep_idx, task_cand = np.unique(
-        key_mat, axis=0, return_index=True, return_inverse=True
-    )
-    task_cand = task_cand.reshape(-1).astype(np.int32)
-    rep_idx = rep_idx.astype(np.int64)
+    sc0 = _sel_cache_of(cache_holder)
+    dedup_key = None
+    if sc0 is not None:
+        dedup_key = hashlib.blake2b(
+            key_mat.tobytes(), digest_size=16
+        ).digest()
+    if sc0 is not None and sc0.dedup_key == dedup_key:
+        rep_idx, task_cand = sc0.dedup
+    else:
+        _, rep_idx, task_cand = np.unique(
+            key_mat, axis=0, return_index=True, return_inverse=True
+        )
+        task_cand = task_cand.reshape(-1).astype(np.int32)
+        rep_idx = rep_idx.astype(np.int64)
+        if sc0 is not None:
+            sc0.dedup_key = dedup_key
+            sc0.dedup = (rep_idx, task_cand)
     C = len(rep_idx)
     if C * N > max(_CLASS_BUDGET_FACTOR * T * k, 1 << 22):
         return None
@@ -336,13 +384,88 @@ def select_candidates(
     cand_static = np.zeros((C, k), np.float32)
     cand_info = np.zeros((3, C), np.int32)
 
+    def _mk_stats(cache_hits_, extra):
+        slab_bytes = (
+            cand_idx.nbytes + cand_static.nbytes + cand_info.nbytes
+            + task_cand.nbytes
+        )
+        stats = {
+            "classes": int(C),
+            "k": int(k),
+            "slab_bytes": int(slab_bytes),
+            # What the dense path would materialize per round on device:
+            # the [T, N] bool mask and f32 score/key matrices.
+            "dense_mask_bytes": int(T) * int(N),
+            "dense_score_bytes": int(T) * int(N) * 4,
+            "truncated_classes": int((cand_info[0] > k).sum()),
+            # Cross-cycle selection-cache effectiveness (classes whose
+            # key rows were reused with only churned columns recomputed).
+            "sel_cache_hits": int(cache_hits_),
+        }
+        stats.update(extra)
+        return stats
+
+    # --- device-resident selection (solver/select_device.py) ------------
+    # Scores, key rows, and the top-K extraction run on the accelerator
+    # against the resident node stacks; everything below this branch is
+    # the host path, which stays bit-equal by construction and serves
+    # as the labeled fallback.
+    dev_res = None
+    select_path = "host"
+    if device_state is not None:
+        from .select_device import device_select_enabled, select_rows
+
+        if not device_select_enabled():
+            select_path = "host:env-disabled"
+        elif has_releasing:
+            select_path = "host:releasing"
+        else:
+            dev_res = select_rows(
+                device_state, mask, rep_idx, rep_req, rep_fit, rep_priv,
+                score_rows_map, idle32, cap32, eps32, cap_ok0,
+                lr_weight, br_weight, k, N, node_fp=node_fp,
+            )
+            select_path = (
+                "device" if dev_res is not None
+                else "host:device-unavailable"
+            )
+    if dev_res is not None:
+        cand_idx = dev_res["cand_idx"]
+        cand_info[0] = np.minimum(
+            dev_res["elig_count"], np.iinfo(np.int32).max
+        )
+        cand_info[1] = dev_res["any_feas"]
+        # Private static rows ride the slab exactly like the host path.
+        for ci in np.nonzero(rep_priv >= 0)[0]:
+            p = int(rep_priv[ci])
+            if p not in score_rows_map:
+                continue
+            srow = np.asarray(score_rows_map[p], np.float32)
+            row = cand_idx[ci]
+            sel = row < N
+            cand_static[ci, sel] = srow[row[sel]]
+        try:
+            from .. import metrics
+
+            metrics.register_device_selection()
+        except Exception:  # pragma: no cover - metrics must never kill
+            pass
+        stats = _mk_stats(dev_res["cache_hits"], {
+            "select_path": select_path,
+            "sel_rows_rebuilt": int(dev_res["rows_rebuilt"]),
+            "sel_cols_patched": int(dev_res["cols_patched"]),
+        })
+        return CandidateSet(
+            task_cand, cand_idx, cand_static, cand_info, stats
+        )
+
     # Cross-cycle key-row cache (see _SelectionCache): usable only when
     # the caller provided a node fingerprint and the cluster holds no
     # Releasing capacity (the releasing column is not cached).
     sc = _sel_cache_of(cache_holder) if node_fp is not None else None
     changed_cols = None
     sig = (N, int(k), R, eps32.tobytes(),
-           float(lr_weight), float(br_weight))
+           float(lr_weight), float(br_weight), _layout_sig_token())
     if sc is not None and not has_releasing:
         ids, vers, node_objs = node_fp
         if (
@@ -366,6 +489,9 @@ def select_candidates(
         sc.node_ids = None
 
     node_ids = np.arange(N, dtype=np.int64)
+    # Composite tie term (see _TIE_BITS): smaller node id -> larger
+    # low bits, so equal-skey boundary picks match lax.top_k's.
+    tie_lo = (np.int64(1) << _TIE_BITS) - 1 - node_ids
     new_rows: Dict[tuple, np.ndarray] = {}
     cache_hits = 0
     chunk = max(1, min(C, (1 << 22) // max(N, 1)))
@@ -476,10 +602,12 @@ def select_candidates(
             cand_info[2, c0:c1] = (rel_ok & feas).any(axis=1)
 
         if k < N:
-            part = np.argpartition(skey, N - k, axis=1)[:, N - k:]
+            skey2 = (skey << _TIE_BITS) + tie_lo[None, :]
+            part = np.argpartition(skey2, N - k, axis=1)[:, N - k:]
+            pkey = np.take_along_axis(skey2, part, axis=1)
         else:
             part = np.broadcast_to(node_ids[None, :], (rows, N)).copy()
-        pkey = np.take_along_axis(skey, part, axis=1)
+            pkey = np.take_along_axis(skey, part, axis=1)
         part = part.astype(np.int32)
         part[pkey < 0] = N           # ineligible picks → sentinel
         part.sort(axis=1)            # ascending node id, sentinels last
@@ -494,21 +622,5 @@ def select_candidates(
             key: row for key, row in new_rows.items() if row is not None
         }
 
-    slab_bytes = (
-        cand_idx.nbytes + cand_static.nbytes + cand_info.nbytes
-        + task_cand.nbytes
-    )
-    stats = {
-        "classes": int(C),
-        "k": int(k),
-        "slab_bytes": int(slab_bytes),
-        # What the dense path would materialize per round on device:
-        # the [T, N] bool mask and f32 score/key matrices.
-        "dense_mask_bytes": int(T) * int(N),
-        "dense_score_bytes": int(T) * int(N) * 4,
-        "truncated_classes": int((cand_info[0] > k).sum()),
-        # Cross-cycle selection-cache effectiveness (classes whose key
-        # rows were reused with only churned columns recomputed).
-        "sel_cache_hits": int(cache_hits),
-    }
+    stats = _mk_stats(cache_hits, {"select_path": select_path})
     return CandidateSet(task_cand, cand_idx, cand_static, cand_info, stats)

@@ -52,10 +52,11 @@ fallback (full solve, labeled by reason)
       hashes are rank-dependent), so bit-parity forces the full solve;
     - ``mesh-changed``: the solver's device layout token moved since
       the save (KBT_SPARSE_SHARD_MODE flip — the device set itself is
-      process-constant): the flat sharded mode is bit-parity but the
-      two-level mode is not, so carried verdicts conservatively void
-      whenever the layout a solve would run under differs from the one
-      that produced them;
+      process-constant — or a node->rack map move under two-level mode:
+      the token carries the rack-permutation digest suffix): the flat
+      sharded mode is bit-parity but the two-level mode is not, so
+      carried verdicts conservatively void whenever the layout a solve
+      would run under differs from the one that produced them;
     - ``drift``: the warm-noop tensorize found node rows dirty beyond
       the narrow ledger (a session-side mutation the plan could not
       see) — the cycle re-runs as a full solve.

@@ -288,6 +288,10 @@ class TestWarmMeshToken:
         from kube_batch_tpu.solver.warm import plan_warm
 
         monkeypatch.setitem(sharding_mod._layout_state, "devices", 8)
+        # A two-level solve earlier in the session may have pinned a
+        # rack digest (suffixing the prospective token); this case is
+        # about the un-suffixed match, so pin the rack state too.
+        monkeypatch.setitem(sharding_mod._layout_state, "rack", None)
         monkeypatch.delenv("KBT_SPARSE_SHARD_MODE", raising=False)
         ssn = self._fake_ssn("8dev:auto")
         # Token matches -> the plan proceeds past the mesh gate (the
@@ -300,6 +304,21 @@ class TestWarmMeshToken:
         monkeypatch.setitem(sharding_mod._layout_state, "devices", None)
         ssn = self._fake_ssn("8dev:auto")
         assert plan_warm(ssn)[0] == "node-dirty"
+
+    def test_plan_falls_back_on_rack_map_change(self, monkeypatch):
+        # Same device count, same mode — but the node->rack
+        # decomposition the warm state was solved under has moved (the
+        # pinned token carries the rack digest suffix). Carrying the
+        # old placements into a re-coordinated two-level dispatch would
+        # mix rack-local solves from two different partitions.
+        from kube_batch_tpu.solver.warm import plan_warm
+
+        monkeypatch.setitem(sharding_mod._layout_state, "devices", 8)
+        monkeypatch.setitem(sharding_mod._layout_state, "rack", "1a2b3c4d")
+        monkeypatch.delenv("KBT_SPARSE_SHARD_MODE", raising=False)
+        ssn = self._fake_ssn("8dev:auto:c8e1f00d")
+        outcome, _live = plan_warm(ssn)
+        assert outcome == "mesh-changed"
 
 
 def _packed_arrays(seed=0, T=256, N=256, R=3):

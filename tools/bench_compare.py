@@ -98,13 +98,23 @@ POLICY: List[Tuple[str, str, float, str]] = [
     ("sparse_scale_xl.select_ms", "lower", 0.50, "single"),
     ("sparse_scale_xl.solve_ms", "lower", 0.50, "single"),
     ("sparse_scale_xl.placed", "count", 0.0, "exact"),
+    # Device-resident selection (PR 16): the headline select_ms above
+    # became the device pass; these rows keep the host reference and
+    # the steady-state churned-warm legs honest, and the parity bit is
+    # the device/host bit-equality contract (exact, must stay 1).
+    ("sparse_scale_xl.select_ms_host", "lower", 0.50, "single"),
+    ("sparse_scale_xl.select_ms_device_warm", "lower", 0.50, "single"),
+    ("sparse_scale_xl.select_device_parity", "count", 0.0, "exact"),
     # Sharded-vs-single sparse A/B (4 forced host devices, subprocess):
     # parity is the contract (flat bit-equal to single); timings track
-    # the collective-overhead trend only.
+    # the collective-overhead trend only. The commit-collective byte
+    # accounting (PR 16, delta-packed exchange) is static shape
+    # arithmetic — machine-independent, must never climb.
     ("sharded_vs_single.parity", "count", 0.0, "exact"),
     ("sharded_vs_single.single_ms", "lower", 0.50, "single"),
     ("sharded_vs_single.flat_ms", "lower", 0.50, "single"),
     ("sharded_vs_single.two_level_ms", "lower", 0.50, "single"),
+    ("sharded_vs_single.commit_bytes_per_round", "lower", 0.0, "ratio"),
     # Cold-takeover failover recovery (PR 13): single-shot successor
     # costs at the headline shape — fresh-cache ingest, journal scan +
     # reconcile (incl. gang re-drives/eviction), first post-recovery

@@ -53,7 +53,11 @@ TABLE_NAMES = {
 # Sharded-solve partition tables (field -> mesh-sharded dim index, or
 # replicated when absent): every key must name a declared SolverInputs
 # field and every dim must exist in its declared rank.
-SHARD_DIM_TABLE_NAMES = ("DENSE_SPMD_SHARD_DIMS", "SPARSE_SHARD_DIMS")
+SHARD_DIM_TABLE_NAMES = (
+    "DENSE_SPMD_SHARD_DIMS",
+    "SPARSE_SHARD_DIMS",
+    "TWO_LEVEL_RACK_DIMS",
+)
 
 _COMMENT_RE = re.compile(
     r"#\s*(?:(f32|f64|i32|i64|bool)\s*)?\[([^\]]*)\]"
