@@ -183,7 +183,8 @@ class AsyncSolveHandle:
 
     @classmethod
     def launch(cls, inputs, use_native: bool, max_rounds: int,
-               fault_hook=None) -> "AsyncSolveHandle":
+               fault_hook=None, allow_pallas: bool = True,
+               ) -> "AsyncSolveHandle":
         if use_native:
             handle = cls("native")
             from ..native import solve_native
@@ -210,7 +211,9 @@ class AsyncSolveHandle:
         # (the multi-chip scale path) and falls back to the cached
         # single-device jit when only one device exists. The call
         # returns the moment dispatch completes.
-        handle._result = solve_sharded(inputs, max_rounds=max_rounds)
+        handle._result = solve_sharded(
+            inputs, max_rounds=max_rounds, allow_pallas=allow_pallas
+        )
         return handle
 
     def done(self) -> bool:
@@ -343,6 +346,33 @@ class AsyncSolveHandle:
             logger.exception("in-flight solve drain failed")
 
 
+def _restamp_deferred(ssn, outcome: str) -> None:
+    """A deferred micro cycle placed NOTHING: re-stamp the arrival
+    batch's pending pods as ``requeued`` in the placement-latency
+    ledger, so the wait they accrue until the periodic cycle picks them
+    up is attributed to the defer (requeue counter + restarted clock)
+    instead of silently absorbed into ``queue_wait``."""
+    from ..api import TaskStatus
+    from ..obs import latency as latency_mod
+
+    if not latency_mod.LEDGER.enabled:
+        return
+    try:
+        pending_key = TaskStatus.PENDING
+        for uid in ssn.dirty_jobs:
+            job = ssn.jobs.get(uid)
+            if job is None:
+                continue
+            for t in (
+                job.task_status_index.get(pending_key) or {}
+            ).values():
+                latency_mod.LEDGER.note_requeued(
+                    t.uid, f"micro-defer:{outcome}", job=uid
+                )
+    except Exception:  # pragma: no cover - metrics must never kill
+        logger.exception("micro-defer requeue restamp failed")
+
+
 class AllocateTpuAction(Action):
     # Eligible for the scheduler's event-driven micro cycles
     # (Scheduler.run_micro): in micro mode the action places only
@@ -371,6 +401,10 @@ class AllocateTpuAction(Action):
         return AsyncSolveHandle.launch(
             inputs, False, self.max_rounds,
             fault_hook=containment.device_fault_hook(),
+            # The pallas bid pass hashes ROW POSITIONS; a warm subset
+            # bundle carries non-contiguous global ranks, so it must
+            # stay on the jnp kernels for tie-hash bit-parity.
+            allow_pallas=getattr(ctx, "subset_jobs", None) is None,
         )
 
     def _solve_ladder(self, ssn, rungs, inputs, ctx, handle, budget,
@@ -566,9 +600,9 @@ class AllocateTpuAction(Action):
         from ..solver import warm as warm_mod
 
         micro = bool(getattr(ssn, "micro_cycle", False))
-        warm_outcome, _warm_live = warm_mod.plan_warm(ssn)
+        warm_outcome, warm_live = warm_mod.plan_warm(ssn)
         last_stats["warm_outcome"] = warm_outcome
-        if micro and warm_outcome not in ("noop", "solve"):
+        if micro and warm_outcome not in ("noop", "solve", "subset"):
             # Micro cycles place ONLY through the warm path: a plan
             # fallback means a full solve, which belongs to the
             # periodic cycle (the fairness/preempt authority). Place
@@ -576,6 +610,8 @@ class AllocateTpuAction(Action):
             last_stats["micro_deferred"] = warm_outcome
             metrics.register_warm_start(warm_outcome)
             metrics.register_micro_cycle("deferred")
+            warm_mod.note_deferred(ssn)
+            _restamp_deferred(ssn, warm_outcome)
             return
         if warm_outcome == "noop":
             t0 = time.perf_counter()
@@ -620,13 +656,28 @@ class AllocateTpuAction(Action):
                 last_stats["micro_deferred"] = warm_outcome
                 metrics.register_warm_start(warm_outcome)
                 metrics.register_micro_cycle("deferred")
+                _restamp_deferred(ssn, warm_outcome)
                 return
         metrics.register_warm_start(warm_outcome)
 
+        tensorize_kw = {}
+        if warm_outcome == "subset":
+            # Rank-stable subset bundle (solver/warm.py): the new work
+            # plus a bounded rotating drain batch of carried jobs, with
+            # GLOBAL ranks computed over the full pending pool so the
+            # solve is bit-equal to the full problem restricted to
+            # these rows.
+            sub = warm_mod.subset_jobs(ssn, warm_live)
+            last_stats["warm_subset_jobs"] = len(sub)
+            tensorize_kw = dict(
+                include_jobs=sub, rank_pool=list(ssn.jobs.values()),
+            )
         t0 = time.perf_counter()
         with span("tensorize"):
             try:
-                inputs, ctx = tensorize(ssn, device=not use_native)
+                inputs, ctx = tensorize(
+                    ssn, device=not use_native, **tensorize_kw
+                )
             except Exception as exc:
                 if use_native:
                     raise
@@ -649,7 +700,7 @@ class AllocateTpuAction(Action):
                     "host-side for the native floor", exc_name,
                 )
                 use_native = True
-                inputs, ctx = tensorize(ssn, device=False)
+                inputs, ctx = tensorize(ssn, device=False, **tensorize_kw)
         _record_phase("tensorize", (time.perf_counter() - t0) * 1e3)
         # Incremental-tensorize forensics (dirty-row counts, fallback
         # reasons) for the bench/BENCH attribution.
@@ -668,11 +719,22 @@ class AllocateTpuAction(Action):
                 explain.record_idle_cycle(ssn)
             except Exception:  # pragma: no cover - forensics only
                 logger.exception("idle-cycle verdict GC failed")
-            # An idle cycle leaves the strongest warm state there is:
-            # zero carried verdicts.
-            last_stats["warm_carried"] = warm_mod.save_warm_state(
-                ssn, None, None
-            )
+            if warm_outcome == "subset":
+                # The subset's rows all vanished host-side (every live
+                # pending task empty-resreq): nothing to solve, but the
+                # carried verdicts STAND — advance like a noop cycle,
+                # never wipe them as an idle save would.
+                warm_mod.advance_noop(ssn)
+                ws = warm_mod.warm_state_of(ssn.cache)
+                last_stats["warm_carried"] = (
+                    len(ws.carried) if ws is not None else 0
+                )
+            else:
+                # An idle cycle leaves the strongest warm state there
+                # is: zero carried verdicts.
+                last_stats["warm_carried"] = warm_mod.save_warm_state(
+                    ssn, None, None
+                )
             if micro:
                 metrics.register_micro_cycle("noop")
             return

@@ -22,7 +22,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Optional
+from typing import Dict, Iterable, List, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -247,6 +247,21 @@ class SchedulerCache(Cache, EventHandlersMixin):
         self._snap_total_allocatable = None
         self._snap_fp: Optional[tuple] = None
         self._snap_fp_priority_gen = -1
+        # Lazy name->fingerprint-position maps ([jobs, nodes]) for the
+        # micro-snapshot ledger verification; rebuilt on demand whenever
+        # the fingerprint name lists grew or were refreshed.
+        self._snap_fp_index: list = [None, None]
+        # Session-clone touch ledger: clone names whose _ver a session
+        # bumped (Session/Statement mutators report via
+        # note_clones_touched at close). Together with the dirty sets
+        # this names every position the micro fast-verification must
+        # recheck; drained by snapshot() with the other ledgers.
+        self._touched_clone_jobs: set = set()
+        self._touched_clone_nodes: set = set()
+        # Forensics: how many snapshots took the ledger-verified micro
+        # fast path vs the full O(n) fingerprint compare.
+        self.snap_ledger_verifies = 0
+        self.snap_full_verifies = 0
         # Priority-class generation: job priority is resolved from the
         # class map at snapshot time, so any class change forces the
         # full pool walk (the per-job priority recheck).
@@ -255,6 +270,15 @@ class SchedulerCache(Cache, EventHandlersMixin):
         # installs a threading.Event setter) fired whenever a pending
         # pod of ours lands in the mirror.
         self._arrival_listener = None
+        # Cross-session plugin fold store (plugins/drf.py,
+        # plugins/proportion.py): per-plugin caches of open-time fold
+        # results keyed on snapshot-clone identity + _ver, so a
+        # steady-state micro open recomputes only the churned jobs'
+        # contributions instead of the whole O(jobs) fold. Entries are
+        # self-invalidating (a mutated job gets a fresh clone, missing
+        # the identity compare), so no coordination with the snapshot
+        # machinery is needed.
+        self.plugin_fold: dict = {}
 
         # --- event-stream integrity (doc/design/robustness.md) ---------
         # Per-object resourceVersion memos + stream gap tracking,
@@ -1025,8 +1049,15 @@ class SchedulerCache(Cache, EventHandlersMixin):
 
     # -- snapshot (reference cache.go:612-659) --------------------------------
 
-    def snapshot(self) -> ClusterInfo:
+    def snapshot(self, micro: bool = False) -> ClusterInfo:
         """Deep-clone the schedulable world — with a copy-on-write pool.
+
+        ``micro=True`` marks a micro-cycle snapshot: the incremental
+        path may verify only the ledger-named positions (plus the
+        appended arrival tail) instead of the full O(n) fingerprint
+        compare — see _snapshot_incremental. Periodic snapshots always
+        run the full verification and remain the reconciliation
+        authority for any out-of-band mutation the ledgers missed.
 
         The reference re-clones everything each 1 Hz cycle
         (cache.go:612-659); at 50k tasks that alone busts the cycle
@@ -1061,7 +1092,7 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 and self._snap_fp_priority_gen == self._priority_gen
                 and os.environ.get("KBT_SNAPSHOT_INCREMENTAL", "1") != "0"
             ):
-                self._snapshot_incremental(snap)
+                self._snapshot_incremental(snap, micro=micro)
             else:
                 self._snapshot_full(snap)
             for name, q in self.queues.items():
@@ -1091,7 +1122,21 @@ class SchedulerCache(Cache, EventHandlersMixin):
             self._dirty_nodes.clear()
             self._dirty_jobs_alloc.clear()
             self._dirty_nodes_alloc.clear()
+            self._touched_clone_jobs.clear()
+            self._touched_clone_nodes.clear()
             return snap
+
+    def note_clones_touched(
+        self, job_uids: Iterable[str], node_names: Iterable[str]
+    ) -> None:
+        """A closing session reports the snapshot clones whose ``_ver``
+        it bumped (allocate/pipeline/evict/dispatch and Statement ops).
+        The micro fast-verification rechecks exactly these positions;
+        without the report every clone would need the O(n) ``_ver``
+        listcomp compare that dominates the warm-noop open floor."""
+        with self.mutex:
+            self._touched_clone_jobs.update(job_uids)
+            self._touched_clone_nodes.update(node_names)
 
     def note_full_absorbed(self, job_keys, node_names) -> None:
         """A tensorize refresh ran against a session carrying these
@@ -1186,8 +1231,12 @@ class SchedulerCache(Cache, EventHandlersMixin):
             fp(self.jobs, pool_jobs), fp(self.nodes, pool_nodes)
         )
         self._snap_fp_priority_gen = self._priority_gen
+        # Position maps are rebuilt lazily on the next micro snapshot
+        # (an eager rebuild would tax every full walk even when no
+        # micro cycle ever consumes it).
+        self._snap_fp_index = [None, None]
 
-    def _snapshot_incremental(self, snap: ClusterInfo) -> None:
+    def _snapshot_incremental(self, snap: ClusterInfo, micro: bool = False) -> None:
         """O(churn) pool update behind an exact O(n)-cheap verification:
         C-level list compares of per-object (identity, _ver) and
         per-pool-entry (identity via pinned reference, clone _ver)
@@ -1199,7 +1248,21 @@ class SchedulerCache(Cache, EventHandlersMixin):
         entry untouched. Key APPENDS (new pods/jobs/nodes) extend the
         fingerprint in place; a deletion or reorder falls back to the
         full walk, as does any priority-class change.
-        KBT_SNAPSHOT_INCREMENTAL=0 forces the full walk every cycle."""
+        KBT_SNAPSHOT_INCREMENTAL=0 forces the full walk every cycle.
+
+        MICRO snapshots (``micro=True``, default-on via
+        KBT_MICRO_VERIFY=ledger) skip the two O(n) Python-level ``_ver``
+        listcomps — the dominant term of the warm-noop open floor at
+        scale — and verify only (a) the positions named by the dirty
+        ledgers (watch events + bind/evict bookkeeping, whose
+        completeness kbtlint's dirty-ledger pass enforces) and the
+        session clone-touch reports (note_clones_touched), plus (b) the
+        appended arrival tail. A deletion named by the ledger still
+        falls back to the full walk. Out-of-band pokes that bypass every
+        ledger (nothing in-tree does) are caught at the next PERIODIC
+        snapshot, which always runs the full compare — the periodic
+        cycle stays the reconciliation authority. KBT_MICRO_VERIFY=full
+        pins the pre-r17 behavior: full verification on every snapshot."""
         job_fp, node_fp = self._snap_fp
         pool_jobs, pool_nodes = self._snap_pool
 
@@ -1249,8 +1312,76 @@ class SchedulerCache(Cache, EventHandlersMixin):
                         idxs.append(i)
             return sorted(idxs) + appended
 
-        node_idxs = dirty_positions(node_fp, self.nodes, pool_nodes)
-        job_idxs = dirty_positions(job_fp, self.jobs, pool_jobs)
+        def dirty_positions_ledger(
+            fp: tuple, which: int, mirror: dict,
+            ledger: Iterable[str],
+        ) -> Optional[List[int]]:
+            names, objs, vers, entries, clone_vers = fp
+            n = len(names)
+            m = len(mirror)
+            if m < n:
+                return None  # deletion: full walk
+            index = self._snap_fp_index[which]
+            if index is None or len(index) != n:
+                # First micro after a refresh / slow-path append: one
+                # O(n) dict build, amortized over the micro burst.
+                index = {nm: i for i, nm in enumerate(names)}
+                self._snap_fp_index[which] = index
+            appended = []
+            if m > n:
+                cur_names = list(mirror.keys())
+                if cur_names[:n] != names:
+                    return None  # replacement/reorder: full walk
+                cur_objs = list(mirror.values())
+                appended = list(range(n, m))
+                names.extend(cur_names[n:])
+                objs.extend(cur_objs[n:])
+                vers.extend(o._ver for o in cur_objs[n:])
+                entries.extend([None] * len(appended))
+                clone_vers.extend([-1] * len(appended))
+                for i in appended:
+                    index[names[i]] = i
+            hit = set()
+            # sorted: the walk order decides nothing (hit is a set,
+            # emitted sorted) but keeps record/replay traces byte-equal.
+            for nm in sorted(ledger):
+                pos = index.get(nm)
+                if pos is None or pos >= n:
+                    continue  # arrival (tail-covered) or came-and-went
+                o = mirror.get(nm)
+                if o is None:
+                    return None  # ledger-named deletion: full walk
+                if objs[pos] is not o or vers[pos] != o._ver:
+                    objs[pos] = o
+                    vers[pos] = o._ver
+                    hit.add(pos)
+                    continue
+                e = entries[pos]
+                cv = e[1]._ver if e is not None else -1
+                if cv != clone_vers[pos]:
+                    hit.add(pos)
+            return sorted(hit) + appended
+
+        fast = micro and os.environ.get(
+            "KBT_MICRO_VERIFY", "ledger"
+        ) != "full"
+        if fast:
+            node_idxs = dirty_positions_ledger(
+                node_fp, 1, self.nodes,
+                self._dirty_nodes | self._dirty_nodes_alloc
+                | self._touched_clone_nodes,
+            )
+            job_idxs = dirty_positions_ledger(
+                job_fp, 0, self.jobs,
+                self._dirty_jobs | self._dirty_jobs_alloc
+                | self._touched_clone_jobs,
+            )
+            if node_idxs is not None and job_idxs is not None:
+                self.snap_ledger_verifies += 1
+        else:
+            node_idxs = dirty_positions(node_fp, self.nodes, pool_nodes)
+            job_idxs = dirty_positions(job_fp, self.jobs, pool_jobs)
+            self.snap_full_verifies += 1
         if node_idxs is None or job_idxs is None:
             self._snapshot_full(snap)
             return

@@ -26,8 +26,8 @@ from .session import Session
 logger = logging.getLogger(__name__)
 
 
-def open_session(cache, tiers: List[Tier]) -> Session:
-    ssn = Session(cache, tiers)
+def open_session(cache, tiers: List[Tier], micro: bool = False) -> Session:
+    ssn = Session(cache, tiers, micro=micro)
     ssn._open()
 
     for tier in tiers:

@@ -84,9 +84,23 @@ def _fold_job_batches(jobs_map, tasks):
 
 
 class Session:
-    def __init__(self, cache, tiers: Optional[List[Tier]] = None):
+    def __init__(
+        self, cache, tiers: Optional[List[Tier]] = None,
+        micro: bool = False,
+    ):
         self.uid = str(_uuid.uuid4())
         self.cache = cache
+        # Micro sessions tell the cache snapshot up front (the
+        # ledger-verified fast path runs inside _open's snapshot call,
+        # before run_micro could set the legacy micro_cycle attribute).
+        self._micro = micro
+        # Clone-touch ledger: uids/names of snapshot clones whose _ver
+        # this session bumps (allocate/pipeline/evict/dispatch and
+        # Statement ops). Reported to the cache at close so the next
+        # micro snapshot's ledger verification rechecks exactly these
+        # positions (cache.note_clones_touched).
+        self._touched_jobs: set = set()
+        self._touched_nodes: set = set()
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
@@ -104,7 +118,7 @@ class Session:
         self._snap_total_allocatable = None
         # Event-driven micro cycle flag (Scheduler.run_micro): actions
         # place only through the warm path when set.
-        self.micro_cycle = False
+        self.micro_cycle = micro
         # The allocate_tpu AsyncSolveHandle currently in flight, if any
         # (drain guard: Statement boundaries and session close block on
         # it so no transaction or teardown races an outstanding solve).
@@ -146,7 +160,7 @@ class Session:
         from ..obs import span
 
         with span("snapshot"):
-            snapshot = self.cache.snapshot()
+            snapshot = self.cache.snapshot(micro=self._micro)
         self.jobs = snapshot.jobs
         self.nodes = snapshot.nodes
         self.queues = snapshot.queues
@@ -196,6 +210,12 @@ class Session:
 
     def _close(self) -> None:
         """reference session.go:119-144"""
+        if self._touched_jobs or self._touched_nodes:
+            self.cache.note_clones_touched(
+                self._touched_jobs, self._touched_nodes
+            )
+            self._touched_jobs = set()
+            self._touched_nodes = set()
         conditioned = self._conditioned_jobs
         for job in self.jobs.values():
             if job.pod_group is None:
@@ -322,11 +342,13 @@ class Session:
         if job is None:
             raise KeyError(f"failed to find job {task.job} when pipelining")
         job.update_task_status(task, TaskStatus.PIPELINED)
+        self._touched_jobs.add(task.job)
         task.node_name = hostname
         node = self.nodes.get(hostname)
         if node is None:
             raise KeyError(f"failed to find node {hostname}")
         node.add_task(task)
+        self._touched_nodes.add(hostname)
         for eh in self.event_handlers:
             if eh.allocate_func is not None:
                 eh.allocate_func(Event(task))
@@ -339,11 +361,13 @@ class Session:
         if job is None:
             raise KeyError(f"failed to find job {task.job}")
         job.update_task_status(task, TaskStatus.ALLOCATED)
+        self._touched_jobs.add(task.job)
         task.node_name = hostname
         node = self.nodes.get(hostname)
         if node is None:
             raise KeyError(f"failed to find node {hostname}")
         node.add_task(task)
+        self._touched_nodes.add(hostname)
         for eh in self.event_handlers:
             if eh.allocate_func is not None:
                 eh.allocate_func(Event(task))
@@ -428,6 +452,8 @@ class Session:
             staged_total += len(ok)
             if len(ok) != len(tasks):
                 hint_ok = False
+            if ok:
+                self._touched_nodes.add(hostname)
             alloc_groups.append((
                 hostname, node, ok, delta if len(ok) == len(tasks) else None
             ))
@@ -446,6 +472,7 @@ class Session:
             for uid, group, delta in job_groups:
                 job = self.jobs[uid]
                 jobs_by_uid[uid] = job
+                self._touched_jobs.add(uid)
                 # Whole-bucket fast path: the solver's tasks ARE the
                 # job's stored PENDING tasks (tensorize hands it the
                 # bucket values), so a group covering the whole bucket
@@ -492,6 +519,7 @@ class Session:
                     logger.warning("failed to find job %s", uid)
                     continue
                 jobs_by_uid[uid] = job
+                self._touched_jobs.add(uid)
                 _move_tasks_logged(job, group, TaskStatus.ALLOCATED)
         t1 = _time.perf_counter()
         last_apply_stats["stage_ms"] = (t1 - t0) * 1e3
@@ -613,6 +641,7 @@ class Session:
                 )
             else:
                 _move_tasks_logged(job, ready, TaskStatus.BINDING)
+            self._touched_jobs.add(job.uid)
             all_ready.extend(ready)
         # Latency is measured creation → dispatch (reference
         # session.go:316), so capture `now` here; but observe only the
@@ -638,6 +667,7 @@ class Session:
         if job is None:
             raise KeyError(f"failed to find job {task.job}")
         job.update_task_status(task, TaskStatus.BINDING)
+        self._touched_jobs.add(task.job)
         # Time from pod creation to bind (reference session.go:316).
         metrics.update_task_schedule_duration(
             max(0.0, _time.time() - task.pod.metadata.creation_timestamp)
@@ -671,9 +701,11 @@ class Session:
         if job is None:
             raise KeyError(f"failed to find job {reclaimee.job}")
         job.update_task_status(reclaimee, TaskStatus.RELEASING)
+        self._touched_jobs.add(reclaimee.job)
         node = self.nodes.get(reclaimee.node_name)
         if node is not None:
             node.update_task(reclaimee)
+            self._touched_nodes.add(reclaimee.node_name)
         for eh in self.event_handlers:
             if eh.deallocate_func is not None:
                 eh.deallocate_func(Event(reclaimee))
@@ -716,10 +748,12 @@ class Session:
             _move_tasks_logged(
                 job, evicted, TaskStatus.RELEASING, resreq_delta=delta
             )
+            self._touched_jobs.add(uid)
             for task in evicted:
                 node = self.nodes.get(task.node_name)
                 if node is not None:
                     node.update_task(task)
+                    self._touched_nodes.add(task.node_name)
             batches.append(JobBatchEvent(job, evicted, delta))
         if not batches:
             return []

@@ -32,9 +32,11 @@ class Statement:
         job = self.ssn.jobs.get(reclaimee.job)
         if job is not None:
             job.update_task_status(reclaimee, TaskStatus.RELEASING)
+            self.ssn._touched_jobs.add(reclaimee.job)
         node = self.ssn.nodes.get(reclaimee.node_name)
         if node is not None:
             node.update_task(reclaimee)
+            self.ssn._touched_nodes.add(reclaimee.node_name)
         for eh in self.ssn.event_handlers:
             if eh.deallocate_func is not None:
                 eh.deallocate_func(Event(reclaimee))
@@ -45,9 +47,11 @@ class Statement:
         job = self.ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.PIPELINED)
+            self.ssn._touched_jobs.add(task.job)
         task.node_name = hostname
         node = self.ssn.nodes.get(hostname)
         if node is not None:
+            self.ssn._touched_nodes.add(hostname)
             try:
                 node.add_task(task)
             except ValueError:
@@ -66,9 +70,11 @@ class Statement:
         job = self.ssn.jobs.get(reclaimee.job)
         if job is not None:
             job.update_task_status(reclaimee, TaskStatus.RUNNING)
+            self.ssn._touched_jobs.add(reclaimee.job)
         node = self.ssn.nodes.get(reclaimee.node_name)
         if node is not None:
             node.update_task(reclaimee)
+            self.ssn._touched_nodes.add(reclaimee.node_name)
         for eh in self.ssn.event_handlers:
             if eh.allocate_func is not None:
                 eh.allocate_func(Event(reclaimee))
@@ -77,8 +83,10 @@ class Statement:
         job = self.ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.PENDING)
+            self.ssn._touched_jobs.add(task.job)
         node = self.ssn.nodes.get(task.node_name)
         if node is not None:
+            self.ssn._touched_nodes.add(task.node_name)
             try:
                 node.remove_task(task)
             except KeyError:

@@ -338,7 +338,8 @@ solver_warm_starts = REGISTRY.register(
         "verdicts reused bit-for-bit, solve skipped), solve (new work "
         "only, residual capacities), or the full-solve fallback reason "
         "(cold/stale/node-dirty/releasing/carried-changed/"
-        "deserved-changed/carried-interleave/drift/disabled)",
+        "deserved-changed/drift/disabled; subset = rank-stable "
+        "subset solve of carried+new work)",
     ),
     ("outcome",),
 )

@@ -574,6 +574,15 @@ def collect_watermarks(cache=None, expensive: bool = True) -> Dict[str, float]:
             values["device_resident_bytes"] = float(
                 sum(arr.nbytes for arr in dc.host.values())
             )
+    # Carried-backlog depth (solver/warm.py): unplaced jobs the subset
+    # solves are rotating through. A congested-but-keeping-up scheduler
+    # holds this roughly flat; sustained growth means arrivals are
+    # outpacing what the micro steady state retires — the soak growth
+    # detector bounds the windowed slope.
+    if cache is not None:
+        ws = getattr(cache, "_warm_solve_state", None)
+        if ws is not None and getattr(ws, "valid", False):
+            values["carried_backlog_depth"] = float(len(ws.carried))
     return values
 
 

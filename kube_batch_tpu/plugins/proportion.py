@@ -55,9 +55,24 @@ class ProportionPlugin(Plugin):
         attr.share = res
 
     def on_session_open(self, ssn) -> None:
+        from .drf import fold_reuse_enabled
+
         # Shared per-session aggregate (one O(nodes) pass for all
         # plugins, not one each).
         self.total_resource = ssn.total_node_allocatable()
+
+        # Cross-session fold reuse: the per-job PENDING walk (request =
+        # allocated + pending) is the O(tasks) term of this open; an
+        # unchanged job keeps its snapshot clone (identity + _ver), so
+        # its pending sum from the previous open is still exact and the
+        # walk runs only for churned jobs. The queue aggregation itself
+        # stays O(jobs) Resource adds — small constant, no task walks.
+        store = (
+            ssn.cache.plugin_fold if fold_reuse_enabled(ssn.cache) else None
+        )
+        pend_cache: Dict[str, tuple] = (
+            store.setdefault("proportion", {}) if store is not None else {}
+        )
 
         # Build queue attributes from jobs (reference :66-99).
         for job in ssn.jobs.values():
@@ -75,10 +90,25 @@ class ProportionPlugin(Plugin):
             # opens stop re-summing every placed task.
             attr.allocated.add(job.allocated)
             attr.request.add(job.allocated)
-            for t in job.task_status_index.get(
-                TaskStatus.PENDING, {}
-            ).values():
-                attr.request.add(t.resreq)
+            ent = pend_cache.get(job.uid)
+            if ent is not None and ent[0] is job and ent[1] == job._ver:
+                pending = ent[2]
+            else:
+                pending = Resource.empty()
+                for t in job.task_status_index.get(
+                    TaskStatus.PENDING, {}
+                ).values():
+                    pending.add(t.resreq)
+                pend_cache[job.uid] = (job, job._ver, pending)
+            attr.request.add(pending)
+        if store is not None and len(pend_cache) > len(ssn.jobs) + 1024:
+            # Bound the store against deleted-job residue (entries are
+            # self-invalidating, so this is memory hygiene only).
+            live = {
+                uid: ent for uid, ent in pend_cache.items()
+                if uid in ssn.jobs
+            }
+            store["proportion"] = live
 
         # Water-filling (reference :100-147).
         remaining = self.total_resource.clone()

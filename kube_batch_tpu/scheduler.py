@@ -256,25 +256,65 @@ class Scheduler:
         # leader elector's fence); fired from the watchdog thread.
         self.fence_hooks: List[Callable[[str], None]] = []
         self.watchdog: Optional[LoopWatchdog] = None
-        # Event-driven micro-cycles (KBT_MICRO=1 opts in): pod arrivals
-        # wake the loop during think time and a bounded fast path
-        # places them through the warm-start plan without waiting for
-        # the period (doc/design/cycle-pipeline.md). The periodic cycle
-        # remains the fairness/preempt authority — a micro cycle that
-        # cannot take the warm path places nothing.
-        self.micro_enabled = os.environ.get("KBT_MICRO", "0") == "1"
+        # Event-driven micro-cycles (KBT_MICRO=0 opts out): pod
+        # arrivals wake the loop during think time and a bounded fast
+        # path places them through the warm-start plan without waiting
+        # for the period (doc/design/cycle-pipeline.md §micro steady
+        # state). Under sustained arrivals micro cycles are the PRIMARY
+        # placement path — noop/solve/subset warm outcomes all place —
+        # and the periodic cycle is the reconciliation/fairness sweep
+        # (preempt/reclaim, anti-entropy, journal pruning). A micro
+        # cycle whose warm plan cannot engage places nothing and
+        # defers.
+        self.micro_enabled = os.environ.get("KBT_MICRO", "1") == "1"
         try:
             self.micro_max_per_period = max(
-                1, int(os.environ.get("KBT_MICRO_MAX", "8"))
+                1, int(os.environ.get("KBT_MICRO_MAX", "64"))
             )
         except ValueError:
-            self.micro_max_per_period = 8
-        try:
-            self.micro_batch_window = max(
-                0.0, float(os.environ.get("KBT_MICRO_BATCH_MS", "5")) / 1e3
-            )
-        except ValueError:
+            self.micro_max_per_period = 64
+        # Coalescing window: KBT_MICRO_BATCH_MS=auto (default) tunes it
+        # from the arrival-rate EWMA each micro wake-up — wait long
+        # enough to coalesce ~KBT_MICRO_BATCH_TARGET arrivals, clamped
+        # to [KBT_MICRO_BATCH_MIN_MS, KBT_MICRO_BATCH_MAX_MS]. A fixed
+        # millisecond value pins it (the pre-r17 behavior).
+        batch_ms = os.environ.get("KBT_MICRO_BATCH_MS", "auto")
+        self.micro_batch_auto = batch_ms.strip().lower() in ("", "auto")
+        if self.micro_batch_auto:
             self.micro_batch_window = 0.005
+        else:
+            try:
+                self.micro_batch_window = max(0.0, float(batch_ms) / 1e3)
+            except ValueError:
+                self.micro_batch_auto = True
+                self.micro_batch_window = 0.005
+
+        def _ms_env(name: str, default: str) -> float:
+            try:
+                return max(
+                    0.0, float(os.environ.get(name, default)) / 1e3
+                )
+            except ValueError:
+                return float(default) / 1e3
+
+        self.micro_batch_min = _ms_env("KBT_MICRO_BATCH_MIN_MS", "1")
+        self.micro_batch_max = max(
+            self.micro_batch_min, _ms_env("KBT_MICRO_BATCH_MAX_MS", "20")
+        )
+        try:
+            self.micro_batch_target = max(
+                1, int(os.environ.get("KBT_MICRO_BATCH_TARGET", "64"))
+            )
+        except ValueError:
+            self.micro_batch_target = 64
+        # Arrival-rate EWMA for the auto-tune (real-clock only: the
+        # simulator drives micro cycles deterministically via
+        # --micro-every and never enters _micro_wait, so this estimator
+        # carries no replay taint).
+        self._arrival_rate = 0.0
+        self._arrival_count = 0
+        self._arrival_mark = time.perf_counter()
+        self.micro_window_last = self.micro_batch_window
         self._micro_arrival = threading.Event()
         self.micro_cycles_run = 0
         # KBT_TRACE_DIR arms the span tracer for the whole loop; the
@@ -438,7 +478,7 @@ class Scheduler:
             # on (cache/event_handlers.add_pod → _notify_arrival).
             arm = getattr(self.cache, "set_arrival_listener", None)
             if arm is not None:
-                arm(self._micro_arrival.set)
+                arm(self._note_arrival)
         while not stop.is_set():
             start = clock.now()
             if not self.run_once_guarded():
@@ -473,14 +513,54 @@ class Scheduler:
         # buffered spans so an operator-stopped run leaves a trace.
         export_trace(tag="trace")
 
+    def _note_arrival(self) -> None:
+        """Cache arrival-listener hook (one tick per arriving pod of
+        ours): feed the rate estimator and wake the think-time wait."""
+        self._arrival_count += 1
+        self._micro_arrival.set()
+
+    def _micro_tuned_window(self) -> float:
+        """The coalescing window for the next micro cycle. With
+        ``KBT_MICRO_BATCH_MS=auto`` (default) it is tuned from the
+        arrival-rate EWMA: wait just long enough to coalesce
+        ``KBT_MICRO_BATCH_TARGET`` arrivals, clamped to
+        [MIN_MS, MAX_MS] — a 10k/s storm batches into few large micro
+        cycles, a trickle places at the MIN_MS floor. A fixed value
+        returns unchanged."""
+        if not self.micro_batch_auto:
+            self.micro_window_last = self.micro_batch_window
+            return self.micro_batch_window
+        now = time.perf_counter()
+        dt = now - self._arrival_mark
+        if dt >= 0.5:
+            inst = self._arrival_count / dt
+            self._arrival_count = 0
+            self._arrival_mark = now
+            self._arrival_rate = (
+                inst
+                if self._arrival_rate == 0.0
+                else 0.7 * self._arrival_rate + 0.3 * inst
+            )
+        rate = self._arrival_rate
+        if rate <= 0.0:
+            window = self.micro_batch_min
+        else:
+            window = min(
+                self.micro_batch_max,
+                max(self.micro_batch_min, self.micro_batch_target / rate),
+            )
+        self.micro_window_last = window
+        return window
+
     def _micro_wait(self, stop, deadline: float) -> None:
         """Think-time tail with event-driven placement: park on the
         arrival event until the period deadline; each wake-up runs one
-        bounded micro cycle (after a short coalescing window so a gang's
-        pod burst lands in one cycle), at most ``micro_max_per_period``
-        per period. A micro-cycle error falls through to the normal
-        per-cycle error accounting — the periodic loop's backoff is not
-        engaged (the next periodic cycle is the recovery authority)."""
+        bounded micro cycle (after the coalescing window — auto-tuned
+        from the arrival rate by default — so a gang's pod burst lands
+        in one cycle), at most ``micro_max_per_period`` per period. A
+        micro-cycle error falls through to the normal per-cycle error
+        accounting — the periodic loop's backoff is not engaged (the
+        next periodic cycle is the recovery authority)."""
         used = 0
         while not stop.is_set() and used < self.micro_max_per_period:
             left = deadline - time.perf_counter()
@@ -488,8 +568,9 @@ class Scheduler:
                 return
             if not self._micro_arrival.wait(timeout=left):
                 return
-            if self.micro_batch_window > 0:
-                stop.wait(self.micro_batch_window)
+            window = self._micro_tuned_window()
+            if window > 0:
+                stop.wait(window)
             self._micro_arrival.clear()
             used += 1
             try:
@@ -524,8 +605,9 @@ class Scheduler:
                     RECORDER.phase("open_session")
                     t0 = time.perf_counter()
                     with span("open_session"):
-                        ssn = open_session(self.cache, self.tiers)
-                    ssn.micro_cycle = True
+                        ssn = open_session(
+                            self.cache, self.tiers, micro=True
+                        )
                     RECORDER.phase_done(
                         "open_session", (time.perf_counter() - t0) * 1e3
                     )

@@ -13,7 +13,13 @@ divergence-repair assert failed (``--require-divergence-repaired`` —
 a divergence was left unrepaired at run end, or the run injected no
 event/solver-corrupt faults at all and proved nothing); 8 the
 device-selection assert failed (``--require-device-selection`` — no
-selection pass ran on the device-resident key matrix).
+selection pass ran on the device-resident key matrix); 9 a congested
+steady-state assert failed (``--require-queue-p99`` — some queue's
+arrival→bind total p99 exceeded the bound, or the ledger stamped
+nothing; ``--max-micro-defer-ratio`` — too many micro cycles deferred
+to the periodic authority instead of placing, or no micro cycle ran
+at all; ``--require-warm-subset`` — no rank-stable subset solve ever
+engaged, so the storm proved nothing about the subset path).
 """
 
 from __future__ import annotations
@@ -127,6 +133,34 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
              "cycle only every Nth sim cycle and the bounded warm-path "
              "micro cycle in between (0 disables)")
     parser.add_argument(
+        "--period", type=float, default=None, metavar="SECONDS",
+        help="virtual seconds per sim cycle (default 1.0). The "
+             "congested smokes shrink this to the micro coalescing "
+             "window (e.g. 0.005) so each tick IS one micro cycle and "
+             "virtual latencies read in wall-SLO units; recorded in "
+             "the trace header for replay")
+    parser.add_argument(
+        "--require-queue-p99", type=float, default=None,
+        metavar="SECONDS",
+        help="exit 9 unless every queue's arrival→bind total p99 "
+             "(virtual clock, obs/latency.py ledger) stays under "
+             "SECONDS — and the ledger actually stamped arrivals (a "
+             "vacuous run proves nothing)")
+    parser.add_argument(
+        "--require-warm-subset", action="store_true",
+        help="exit 9 unless at least one rank-stable subset solve "
+             "engaged (solver_warm_starts_total{outcome=subset}) — a "
+             "congested storm that never forms a carried backlog "
+             "proves nothing about the subset path")
+    parser.add_argument(
+        "--max-micro-defer-ratio", type=float, default=None,
+        metavar="R",
+        help="exit 9 if deferred micro cycles exceed fraction R of "
+             "all micro cycles (scheduler_micro_cycles_total by "
+             "outcome), or if no micro cycle ran — the congested "
+             "steady state must place through the warm/subset path, "
+             "not punt to the periodic authority")
+    parser.add_argument(
         "--host-devices", type=int, default=0, metavar="N",
         help="force >=N virtual CPU host devices before the first "
              "backend resolution (multi-device sharding smokes)")
@@ -205,6 +239,7 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         cycles=ns.cycles,
         seed=ns.seed,
         faults=ns.faults,
+        **({"period": ns.period} if ns.period is not None else {}),
         workload=workload,
         conf=ns.scheduler_conf or SIM_DEFAULT_CONF,
         backend=ns.backend,
@@ -264,6 +299,21 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         device_selections = int(metrics.solver_selection_device.total())
         out["device_selections"] = device_selections
+    micro_outcomes = None
+    if ns.max_micro_defer_ratio is not None:
+        from .. import metrics
+
+        micro_outcomes = {
+            o: int(metrics.scheduler_micro_cycles.get((o,)))
+            for o in ("solve", "noop", "deferred")
+        }
+        out["micro_outcomes"] = micro_outcomes
+    subset_solves = None
+    if ns.require_warm_subset:
+        from ..metrics.metrics import solver_warm_starts
+
+        subset_solves = int(solver_warm_starts.get(("subset",)))
+        out["warm_subset_solves"] = subset_solves
     if ns.report_out:
         with open(ns.report_out, "w") as f:
             json.dump(out, f, indent=2, sort_keys=True)
@@ -349,4 +399,40 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
             return 7
+    if ns.require_queue_p99 is not None:
+        latency = report.latency or {}
+        queue_p99 = latency.get("queue_p99_s") or {}
+        applied = latency.get("applied", 0)
+        # queue_p99_s omits all-zero queues (sub-tick placement on the
+        # virtual clock is exactly 0.0s), so an empty dict with binds
+        # applied means every queue beat the bound.
+        worst = max(queue_p99.values(), default=0.0)
+        if not applied or worst > ns.require_queue_p99:
+            print(
+                f"sim: congested p99 assert failed — per-queue total "
+                f"p99 {queue_p99} (worst {worst}) vs bound "
+                f"{ns.require_queue_p99}s, applied={applied} "
+                f"(--require-queue-p99)",
+                file=sys.stderr,
+            )
+            return 9
+    if ns.require_warm_subset and not subset_solves:
+        print(
+            "sim: no rank-stable subset solve engaged "
+            "(--require-warm-subset)",
+            file=sys.stderr,
+        )
+        return 9
+    if ns.max_micro_defer_ratio is not None:
+        ran = sum(micro_outcomes.values())
+        deferred = micro_outcomes["deferred"]
+        if not ran or deferred > ns.max_micro_defer_ratio * ran:
+            print(
+                f"sim: micro defer-ratio assert failed — "
+                f"{micro_outcomes} → deferred {deferred}/{ran} vs "
+                f"bound {ns.max_micro_defer_ratio} "
+                f"(--max-micro-defer-ratio)",
+                file=sys.stderr,
+            )
+            return 9
     return 0

@@ -1452,7 +1452,17 @@ class ClusterSimulator:
             "pending": sum(
                 1 for p in pods if p.status.phase == PodPhase.PENDING
             ),
+            # Carried-backlog depth (solver/warm.py): a pure function
+            # of solve history, so replay-stable — congested-regime
+            # benches read the series straight off the trace records.
+            "carried": self._carried_depth(),
         }
+
+    def _carried_depth(self) -> int:
+        ws = getattr(self.cache, "_warm_solve_state", None)
+        if ws is None or not getattr(ws, "valid", False):
+            return 0
+        return len(ws.carried)
 
 
 def run_sim(cfg: SimConfig) -> Tuple[SimReport, List[dict]]:

@@ -83,6 +83,16 @@ GROWTH_POLICY: Dict[str, GrowthPolicy] = {
     # with their pods/jobs — sustained linear growth here is a per-pod
     # ledger leak, exactly the class the metrics-GC pattern forbids.
     "latency_entries": GrowthPolicy(abs_floor=512, rel_floor=0.50),
+    # Carried-backlog depth (solver/warm.py): the jobs subset solves
+    # rotate through between periodic cycles. Congestion legitimately
+    # holds it high and bursty — but a sustained LINEAR climb means
+    # arrivals outpace the micro steady state's drain budget and the
+    # scheduler is quietly falling behind (placements still land, just
+    # ever later). Floors sized so saturation plateaus and burst waves
+    # pass while an unbounded admission leak trips.
+    "carried_backlog_depth": GrowthPolicy(
+        abs_floor=64, rel_floor=0.50, r2_min=0.7
+    ),
 }
 
 DRIFT_POLICY: Dict[str, DriftPolicy] = {

@@ -715,7 +715,6 @@ def solve(inputs: SolverInputs, max_rounds: int = 256,
 
     # Pad node tables with one dummy row (index N) for tasks with no bid.
     idle0 = inputs.node_idle
-    arange_t = jnp.arange(T, dtype=jnp.int32)
 
     # Materialize the factorized predicate mask / static scores on-device
     # (masks.py): O(T + G·N + P·N) crosses the host↔device boundary, not
@@ -757,7 +756,11 @@ def solve(inputs: SolverInputs, max_rounds: int = 256,
     round_kw = dict(
         task_req=inputs.task_req, task_fit=inputs.task_fit,
         task_rank=inputs.task_rank, task_queue=inputs.task_queue,
-        task_sel=inputs.task_valid, task_ids=arange_t,
+        # Bid-key tie hashes use the GLOBAL rank, not the row position:
+        # identical for full bundles (rank == arange there) and the
+        # property that makes warm SUBSET bundles (solver/warm.py) bid
+        # exactly like the full problem restricted to their rows.
+        task_sel=inputs.task_valid, task_ids=inputs.task_rank,
         feas=feas0, static_score=static_score,
         fits_releasing=fits_releasing, blocked_of=job_blocked,
         node_cap=inputs.node_cap, node_max_tasks=inputs.node_max_tasks,
@@ -903,7 +906,9 @@ def _dense_tail(
         tail_kw = dict(
             task_req=req2, task_fit=fit2,
             task_rank=rank2, task_queue=queue2,
-            task_sel=valid2, task_ids=idxs,
+            # Global-rank tie hashes (== idxs on full bundles; diverges
+            # only on warm subset bundles, where rank is the contract).
+            task_sel=valid2, task_ids=rank2,
             feas=feas2, static_score=static2,
             fits_releasing=fits_rel2, blocked_of=blocked_from,
             **shared_kw,
@@ -1025,7 +1030,6 @@ def solve_staged(
     )
 
     INT_MAX = jnp.iinfo(jnp.int32).max
-    arange_t = jnp.arange(T, dtype=jnp.int32)
 
     def job_blocked(failed):
         first_fail = jax.ops.segment_min(
@@ -1043,11 +1047,13 @@ def solve_staged(
     head_kw = dict(
         task_req=inputs.task_req, task_fit=inputs.task_fit,
         task_rank=inputs.task_rank, task_queue=inputs.task_queue,
-        task_sel=inputs.task_valid, task_ids=arange_t,
+        # GLOBAL-rank tie hashes, like the tail (== row position on full
+        # bundles; the warm subset path depends on the rank form).
+        task_sel=inputs.task_valid, task_ids=inputs.task_rank,
         feas=feas0, static_score=static_score,
         fits_releasing=fits_releasing, blocked_of=job_blocked,
-        # The tail stays on the jnp path: its bid-key hash uses GLOBAL
-        # task ids (idxs) while the kernel hashes row positions.
+        # The pallas kernel hashes ROW POSITIONS — bit-equal only while
+        # rank == arange, so subset bundles dispatch allow_pallas=False.
         use_pallas=allow_pallas and _should_use_pallas(),
         **shared_kw,
     )
@@ -1300,7 +1306,7 @@ def solve_sparse(
         task_req=inputs.task_req, task_fit=inputs.task_fit,
         task_rank=inputs.task_rank, task_queue=inputs.task_queue,
         task_sel=inputs.task_valid,
-        task_ids=jnp.arange(T, dtype=jnp.int32),
+        task_ids=inputs.task_rank,
         cand_nodes=cand_nodes, cand_static=cand_static,
         cand_total=cand_total,
         fits_releasing=fits_releasing, blocked_of=job_blocked,

@@ -540,6 +540,7 @@ def solve_sharded(
     staged=None,
     tail_bucket: int = 3072,
     impl: str = "spmd",
+    allow_pallas: bool = True,
 ):
     """Run the batched solve with the node axis sharded over ``mesh``.
 
@@ -601,12 +602,17 @@ def solve_sharded(
         from .kernels import solve_full_jit, solve_jit, solve_staged_jit
 
         if staged is None:
-            return solve_jit(inputs, max_rounds=max_rounds)
+            return solve_jit(
+                inputs, max_rounds=max_rounds, allow_pallas=allow_pallas
+            )
         if staged:
             return solve_staged_jit(
-                inputs, max_rounds=max_rounds, tail_bucket=tail_bucket
+                inputs, max_rounds=max_rounds, tail_bucket=tail_bucket,
+                allow_pallas=allow_pallas,
             )
-        return solve_full_jit(inputs, max_rounds=max_rounds)
+        return solve_full_jit(
+            inputs, max_rounds=max_rounds, allow_pallas=allow_pallas
+        )
 
     _note_dispatch(f"dense-{impl}", mesh.size)
     step, inputs = sharded_step(

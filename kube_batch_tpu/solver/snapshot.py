@@ -129,6 +129,12 @@ class SnapshotContext:
     # the action's pipeline epilogue skip its candidate scan outright in
     # the common no-eviction cycle.
     has_releasing: bool = False
+    # Warm SUBSET bundle (solver/warm.py): the uids of the jobs whose
+    # tasks this bundle covers (None for full bundles) and the full
+    # pending pool's task count — the global rank domain the subset's
+    # task_rank values index into.
+    subset_jobs: Optional[frozenset] = None
+    rank_total: int = 0
 
 
 def _sorted_by(items, less_fn):
@@ -710,12 +716,22 @@ def tensorize(
     pad=True,
     device=True,
     warm_noop=False,
+    rank_pool: Optional[List[JobInfo]] = None,
 ):
     """Build `(inputs, SnapshotContext)` for the session's pending,
     non-best-effort tasks, or ``(None, None)`` if there is nothing to solve.
 
     ``include_jobs`` restricts the task set (used by tests and by actions
-    that solve for a subset). With ``pad`` (default), array shapes are
+    that solve for a subset). ``rank_pool`` (warm SUBSET bundles,
+    solver/warm.py) additionally names the FULL pending job pool the
+    ordering pipeline runs over: queue ranks, job order, progressive-
+    filling keys, and the final lexsort are computed across every pool
+    task — cheap host numpy, O(pool) — and only ``include_jobs``' rows
+    are sliced into the solver tensors, each carrying its GLOBAL rank in
+    ``task_rank``. The solver's bid-key tie hashes consume that rank
+    (kernels.bid_keys ``task_ids``), so the subset's bid order is the
+    full problem's restricted to those rows, bit for bit. With ``pad``
+    (default), array shapes are
     rounded up to buckets (padded tasks/nodes are marked invalid) so a
     long-running scheduler re-jits only when the cluster crosses a bucket
     boundary, not on every snapshot.
@@ -748,7 +764,13 @@ def tensorize(
         _absorb_dirty(ssn)
         last_tensorize_stats["warm_noop"] = True
         return None, None
-    job_pool = include_jobs if include_jobs is not None else ssn.jobs.values()
+    if rank_pool is not None:
+        job_pool = rank_pool
+    elif include_jobs is not None:
+        job_pool = include_jobs
+    else:
+        job_pool = ssn.jobs.values()
+    subset_mode = rank_pool is not None and include_jobs is not None
 
     # --- ordered task list: queue rank → job rank → task rank -------------
     # Only jobs with at least one PENDING task participate: a fully
@@ -918,11 +940,32 @@ def tensorize(
     order = np.lexsort(
         (np.asarray(flat_pos), np.asarray(flat_qi), keys)
     )
+    rank_total = T
+    if subset_mode:
+        # SUBSET bundle: the ordering above ran over the full pool, so
+        # each kept row keeps its GLOBAL position as its rank; only the
+        # kept rows pay predicates/scores/selection/solve.
+        sub_uids = {j.uid for j in include_jobs}
+        keep = np.fromiter(
+            (flat_tasks[i].job in sub_uids for i in order), bool, count=T
+        )
+        gpos = np.nonzero(keep)[0].astype(np.int32)
+        order = order[keep]
+        T = int(len(order))
+        last_tensorize_stats["subset"] = {
+            "pool_tasks": rank_total,
+            "subset_tasks": T,
+            "subset_jobs": len(sub_uids),
+        }
+        if T == 0:
+            return None, None
+        task_rank = gpos
+    else:
+        task_rank = np.arange(T, dtype=np.int32)
     tasks = [flat_tasks[i] for i in order]
     task_req = req_mat[order].astype(np.float32)
     task_fit = fit_mat[order].astype(np.float32)
     task_queue = np.asarray(flat_qi, np.int32)[order]
-    task_rank = np.arange(T, dtype=np.int32)
     # Dense job segment ids in first-occurrence order: the kernel only
     # needs task_job as a per-job segment id < T (segment_min grouping),
     # so a dict factorization replaces the 50k-string np.unique sort
@@ -1105,7 +1148,14 @@ def tensorize(
 
     task_req = pad_rows(task_req, Tp)
     task_fit = pad_rows(task_fit, Tp)
-    task_rank = np.arange(Tp, dtype=np.int32)
+    if subset_mode:
+        # Padded rows take unique ranks past the pool so they can never
+        # collide with a real global rank in tie hashes or job breaks.
+        task_rank = np.concatenate(
+            [task_rank, rank_total + np.arange(Tp - T, dtype=np.int32)]
+        )
+    else:
+        task_rank = np.arange(Tp, dtype=np.int32)
     task_queue = pad_rows(task_queue, Tp)
     # Padded tasks get unique job ids so they never interact with
     # job_blocked segment reductions.
@@ -1202,6 +1252,10 @@ def tensorize(
         node_idle_host=node_idle64.copy(),
         host_inputs=host_inputs,
         has_releasing=bool(node_rel64.any()),
+        subset_jobs=(
+            frozenset(j.uid for j in include_jobs) if subset_mode else None
+        ),
+        rank_total=rank_total,
     )
     if not device:
         return host_inputs, ctx

@@ -505,7 +505,9 @@ def _solve_spmd_local(inputs: SolverInputs, max_rounds: int,
         task_req=inputs.task_req, task_fit=inputs.task_fit,
         task_rank=inputs.task_rank, task_queue=inputs.task_queue,
         task_sel=inputs.task_valid,
-        task_ids=jnp.arange(T, dtype=jnp.int32),
+        # Global-rank tie hashes (== arange on full bundles; warm subset
+        # bundles carry non-contiguous ranks — see kernels.solve).
+        task_ids=inputs.task_rank,
         feas_l=feas_l, static_l=static_l,
         fits_releasing=fits_releasing, blocked_of=job_blocked,
         n_local=n_local,
@@ -594,7 +596,7 @@ def _solve_spmd_local(inputs: SolverInputs, max_rounds: int,
         tail_kw = dict(
             task_req=inputs.task_req[idxs], task_fit=inputs.task_fit[idxs],
             task_rank=rank2, task_queue=inputs.task_queue[idxs],
-            task_sel=valid2, task_ids=idxs,
+            task_sel=valid2, task_ids=rank2,
             feas_l=tail_subset_feas(inputs, idxs, valid2),
             static_l=tail_subset_static(inputs, idxs),
             fits_releasing=fits_releasing[idxs],
@@ -972,10 +974,13 @@ def _spmd_sparse_round(
     K = cand_nodes_l.shape[1]
     code_dtype = _commit_code_dtype(K)
     arange_l = jnp.arange(Tl, dtype=jnp.int32)
-    task_ids_l = t_off + arange_l
 
     def loc(v: jnp.ndarray) -> jnp.ndarray:
         return lax.dynamic_slice_in_dim(v, t_off, Tl)
+
+    # Global-RANK tie hashes (== t_off + arange on full bundles; warm
+    # subset bundles carry non-contiguous ranks — see kernels.solve).
+    task_ids_l = loc(task_rank)
 
     pending = assigned < 0
     q_over = less_equal(queue_deserved, qalloc, eps)
@@ -1132,10 +1137,10 @@ def _solve_sparse_spmd_local(
             inputs.queue_allocated + headroom / nshards,
         )
         arange_l = jnp.arange(Tl, dtype=jnp.int32)
-        task_ids_l = t_off + arange_l
         req_l = loc(inputs.task_req)
         fit_l = loc(inputs.task_fit)
         rank_l = loc(inputs.task_rank)
+        task_ids_l = rank_l
         queue_l = loc(inputs.task_queue)
         valid_task_l = loc(inputs.task_valid)
         in_rack = (cand_nodes_l >= rack_lo) & (cand_nodes_l < rack_hi)

@@ -29,27 +29,48 @@ per cycle, how much of the previous solve survives:
     incremental tensorize / device caches. This is the steady
     placement-wave regime: cycle cost scales with churn.
 
+``subset``
+    New work arrived WHILE unassigned tasks are carried. The new work
+    (plus a bounded, rotating drain batch of carried jobs) solves as a
+    rank-stable SUBSET problem: tensorize runs its ordering pipeline
+    over the FULL pending pool — cheap host numpy — and slices solver
+    tensors to the subset rows, each carrying its GLOBAL rank
+    (``tensorize(rank_pool=...)``), which the kernels consume for both
+    priority ordering and bid-key tie hashes. Exactness: under this
+    plan's preconditions every carried task outside the subset sits at
+    the previous solve's fixed point (failed, job-broken, or budget-
+    gated) against capacities that only shrink and budgets that only
+    tighten, so the full problem would leave it unassigned and its rows
+    contribute exact zeros to every queue/node reduction (x + 0.0 == x
+    in f32) — the subset solve's placements are bit-equal to the full
+    solve restricted to the subset, and the full solve places nothing
+    else. This retires the former ``carried-interleave`` full-solve
+    fallback: congested cycles (carried backlog + arrivals) now cost
+    O(churn), not O(pending).
+
+Events that merely VOID a carried verdict no longer force a full
+solve: a third-party node event (capacity may have GROWN — every
+carried verdict re-solves), a mutated carried job (completion,
+preempt, partial-gang revert), or a moved queue budget (that queue's
+carried jobs re-solve) each FOLD the affected carried jobs into the
+subset instead. The exactness argument is unchanged — a re-solved row
+is trivially exact, and only rows whose preconditions still hold stay
+outside the subset. This is what keeps the micro path primary in the
+congested regime, where completions dirty nodes every coalescing
+window.
+
 fallback (full solve, labeled by reason)
-    Any delta precondition failure re-solves everything from the ground
-    truth — bit-parity with a cold scheduler is the invariant the
-    randomized churn tests pin. Reasons:
+    The remaining precondition failures re-solve everything from the
+    ground truth — bit-parity with a cold scheduler is the invariant
+    the randomized churn tests pin. Reasons:
 
     - ``cold`` / ``stale``: no warm state, or a snapshot generation gap
-      (some cycle's ledger drained without a warm save);
-    - ``node-dirty``: a third-party node event (death, watch update,
-      eviction) — capacities may have GROWN, carried verdicts void;
+      (some cycle's ledger drained without a warm save AND without the
+      deferred-micro dirt fold below);
+    - ``node-dirty``: a third-party node event with NO pending work
+      anywhere (nothing to subset-solve — the periodic path refreshes);
     - ``releasing``: Releasing capacity exists — the pipeline epilogue
       may place carried tasks, outside the fixed-point argument;
-    - ``carried-changed``: a carried job was mutated by anything other
-      than the scheduler's own binds (completion, preempt, partial-gang
-      revert), or its pending remainder drifted from the solve's;
-    - ``deserved-changed``: a carried job's queue budget (proportion's
-      water-filled deserved) moved — a previously budget-blocked task
-      might now pass;
-    - ``carried-interleave``: new work arrived WHILE unassigned tasks
-      are carried. The subset problem would order/tie-break differently
-      than the full problem (progressive-filling keys and bid-key
-      hashes are rank-dependent), so bit-parity forces the full solve;
     - ``mesh-changed``: the solver's device layout token moved since
       the save (KBT_SPARSE_SHARD_MODE flip — the device set itself is
       process-constant — or a node->rack map move under two-level mode:
@@ -60,6 +81,13 @@ fallback (full solve, labeled by reason)
     - ``drift``: the warm-noop tensorize found node rows dirty beyond
       the narrow ledger (a session-side mutation the plan could not
       see) — the cycle re-runs as a full solve.
+
+A micro cycle that still hits a fallback places nothing and defers —
+but its session has already DRAINED the cache's dirty ledgers, so
+``note_deferred`` folds the drained deltas into the state
+(``pending_*`` sets) and keeps the snapshot-generation continuity;
+without it one defer would strand every following micro cycle on
+``stale`` until the next periodic solve.
 
 The state lives on the SchedulerCache (``_warm_solve_state``), the same
 lifetime pattern as the tensorize/device caches. ``plan_warm`` is
@@ -84,12 +112,26 @@ class WarmSolveState:
 
     __slots__ = (
         "valid", "snap_gen", "carried", "queue_deserved", "has_releasing",
-        "mesh_token",
+        "mesh_token", "drain_cursor",
+        "pending_dirty_jobs", "pending_dirty_nodes", "pending_narrow",
     )
 
     def __init__(self):
         self.valid = False
         self.snap_gen = -1
+        # Dirty-ledger deltas drained by DEFERRED micro cycles
+        # (note_deferred): the next plan unions them with its session's
+        # ledgers, so a defer never loses churn information. Cleared on
+        # every successful warm save / noop advance.
+        self.pending_dirty_jobs: set = set()
+        self.pending_dirty_nodes: set = set()
+        self.pending_narrow: set = set()
+        # Rotating position into the sorted carried-uid list: each
+        # subset solve drains the next KBT_MICRO_DRAIN carried jobs so
+        # every carried verdict is refreshed within
+        # ceil(carried / drain) subset cycles. Advanced only by subset
+        # solves — a pure function of solve history, so replay-stable.
+        self.drain_cursor = 0
         # Solver device-layout token at save time
         # (sharding.prospective_layout_token); None until a sharded
         # dispatch has pinned the device count.
@@ -173,22 +215,34 @@ def plan_warm(ssn) -> Tuple[str, List]:
         # (mode flip; device count is process-constant): conservatively
         # re-solve — the two-level mode is not bit-parity.
         return "mesh-changed", []
-    if ssn.dirty_nodes:
-        return "node-dirty", []
     if ws.has_releasing:
         return "releasing", []
+
+    # The effective delta since the last warm processing: this
+    # session's drained ledgers plus anything deferred micro cycles
+    # drained before it (note_deferred).
+    dirty_jobs = set(ssn.dirty_jobs) | ws.pending_dirty_jobs
+    node_dirty = bool(ssn.dirty_nodes) or bool(ws.pending_dirty_nodes)
+    narrow = set(ssn.dirty_jobs_narrow) | ws.pending_narrow
 
     pending_key = TaskStatus.PENDING
     carried = ws.carried
     live: List = []
     seen = set()
-    for uid in ssn.dirty_jobs:
+    # Sorted: the walk order must be replay-stable (kbtlint
+    # replay-determinism) now that the union is a fresh set.
+    for uid in sorted(dirty_jobs):
         job = ssn.jobs.get(uid)
         if job is not None and job.task_status_index.get(pending_key):
             live.append(job)
             seen.add(uid)
 
-    narrow = ssn.dirty_jobs_narrow
+    # Carried verdicts whose preconditions no longer hold are FOLDED
+    # into the subset (re-solved against current residuals/budgets)
+    # instead of forcing a full solve — re-solved rows are trivially
+    # exact, and only rows whose preconditions still hold stay outside.
+    forced: List = []
+    remaining: Dict[str, List] = {}  # queue uid -> kept-out carried jobs
     for uid, (obj, ver, remainder) in carried.items():
         if uid in seen:
             # Full-dirty carried job: its re-solve is part of the live
@@ -196,8 +250,18 @@ def plan_warm(ssn) -> Tuple[str, List]:
             continue
         job = ssn.jobs.get(uid)
         if job is None:
-            return "carried-changed", []
+            # Deleted carried job: the full problem no longer contains
+            # it — the entry is dead (advance/save paths prune it).
+            continue
+        if node_dirty:
+            # Third-party node event: capacities may have GROWN, so any
+            # carried verdict might now be placeable — every carried
+            # job re-solves inside the subset.
+            forced.append(job)
+            seen.add(uid)
+            continue
         if job is obj and job._ver == ver:
+            remaining.setdefault(obj.queue, []).append(job)
             continue
         if (
             uid in narrow
@@ -206,12 +270,17 @@ def plan_warm(ssn) -> Tuple[str, List]:
             # Bind-only churn with the exact unassigned remainder left
             # pending: the job is in precisely the state the previous
             # solve ended in.
+            remaining.setdefault(job.queue, []).append(job)
             continue
-        return "carried-changed", []
+        # Mutated carried job (completion, preempt, partial-gang
+        # revert) or a drifted remainder: its old verdict is void —
+        # re-solve it.
+        forced.append(job)
+        seen.add(uid)
 
     # A narrow-dirty job that is NOT carried but has pending tasks means
     # a bind-bookkeeping revert put an assigned task back — re-solve it.
-    for uid in narrow:
+    for uid in sorted(narrow):
         if uid in carried or uid in seen:
             continue
         job = ssn.jobs.get(uid)
@@ -219,24 +288,84 @@ def plan_warm(ssn) -> Tuple[str, List]:
             live.append(job)
             seen.add(uid)
 
-    if carried:
-        quids = {obj.queue for (obj, _v, _r) in carried.values()}
-        # Sorted: the budget re-check must walk queues in a replay-
-        # stable order (kbtlint replay-determinism).
-        for quid in sorted(quids):
-            queue = ssn.queues.get(quid)
-            cur = _deserved_of(ssn, queue) if queue is not None else None
-            if not _res_eq(cur, ws.queue_deserved.get(quid)):
-                return "deserved-changed", []
+    # Budget re-check over the queues whose carried jobs would stay
+    # OUTSIDE the subset: a moved deserved budget voids exactly that
+    # queue's kept-out verdicts — fold them in too. Sorted: the walk
+    # must be replay-stable (kbtlint replay-determinism).
+    for quid in sorted(remaining):
+        queue = ssn.queues.get(quid)
+        cur = _deserved_of(ssn, queue) if queue is not None else None
+        if not _res_eq(cur, ws.queue_deserved.get(quid)):
+            for job in remaining[quid]:
+                forced.append(job)
+                seen.add(job.uid)
 
-    if not live:
+    if not live and not forced:
+        if node_dirty:
+            # A node event with no pending work anywhere: nothing to
+            # subset-solve — let the full path refresh the arrays.
+            return "node-dirty", []
         return "noop", []
     if carried:
-        # Carried unassigned tasks would interleave with the new work:
-        # subset ordering/tie-breaking diverges from the full problem,
-        # so bit-parity demands the full solve.
-        return "carried-interleave", live
+        # Carried unassigned tasks interleave with the new work: solve
+        # the new work (plus every voided carried verdict) as a
+        # rank-stable SUBSET problem (see module doc;
+        # tensorize(rank_pool=...) carries global ranks so ordering and
+        # tie hashes match the full problem restricted to these rows).
+        return "subset", live + forced
     return "solve", live
+
+
+def micro_drain_limit() -> int:
+    """KBT_MICRO_DRAIN: carried jobs re-examined per subset solve."""
+    try:
+        return max(0, int(os.environ.get("KBT_MICRO_DRAIN", "32")))
+    except ValueError:
+        return 32
+
+
+def subset_jobs(ssn: "object", live: List) -> List:
+    """The subset bundle's job list: the live jobs plus a bounded drain
+    batch of carried jobs — the next ``KBT_MICRO_DRAIN`` in rotating
+    sorted-uid order, so every carried verdict is refreshed within
+    ``ceil(carried / drain)`` subset cycles. Any superset of ``live``
+    is parity-safe: carried tasks are inert in the full problem under
+    this plan's preconditions, in or out of the subset. The cursor
+    advances only here, a pure function of solve history, so sim
+    replays stay byte-stable."""
+    ws = warm_state_of(ssn.cache)
+    jobs = list(live)
+    if ws is None or not ws.carried:
+        return jobs
+    seen = {j.uid for j in live}
+    uids = sorted(u for u in ws.carried if u not in seen)
+    if not uids:
+        return jobs
+    n = min(micro_drain_limit(), len(uids))
+    cur = ws.drain_cursor % len(uids)
+    picked = [uids[(cur + i) % len(uids)] for i in range(n)]
+    ws.drain_cursor = (cur + n) % len(uids)
+    for uid in picked:
+        job = ssn.jobs.get(uid)
+        if job is not None:
+            jobs.append(job)
+    return jobs
+
+
+def note_deferred(ssn: "object") -> None:
+    """A micro cycle deferred (plan fallback) after its session already
+    DRAINED the cache's dirty ledgers: fold the drained deltas into the
+    warm state so the next plan still sees them, and keep the
+    snapshot-generation continuity — without this a single defer would
+    strand every following micro cycle on ``stale`` until the next
+    periodic solve."""
+    ws = warm_state_of(ssn.cache)
+    if ws is None or not ws.valid:
+        return
+    ws.pending_dirty_jobs.update(ssn.dirty_jobs)
+    ws.pending_dirty_nodes.update(ssn.dirty_nodes)
+    ws.pending_narrow.update(ssn.dirty_jobs_narrow)
+    ws.snap_gen = getattr(ssn, "snap_gen", 0)
 
 
 def advance_noop(ssn) -> None:
@@ -245,15 +374,21 @@ def advance_noop(ssn) -> None:
     (a bind re-minted the job's clone) are re-pinned to the current
     clone — otherwise the very next cycle's identity check would fail
     against the drained ledger and force a spurious carried-changed
-    full solve after every partial placement wave."""
+    full solve after every partial placement wave. Entries whose job
+    was deleted are pruned (the full problem no longer contains them)."""
     ws = warm_state_of(ssn.cache)
     if ws is None:
         return
     ws.snap_gen = getattr(ssn, "snap_gen", 0)
     ws.mesh_token = _layout_token()
+    ws.pending_dirty_jobs.clear()
+    ws.pending_dirty_nodes.clear()
+    ws.pending_narrow.clear()
     for uid, (obj, ver, remainder) in list(ws.carried.items()):
         job = ssn.jobs.get(uid)
-        if job is not None and (job is not obj or job._ver != ver):
+        if job is None:
+            del ws.carried[uid]
+        elif job is not obj or job._ver != ver:
             ws.carried[uid] = (job, job._ver, remainder)
 
 
@@ -266,13 +401,29 @@ def invalidate(cache) -> None:
 def save_warm_state(ssn, ctx, assigned) -> int:
     """Record this solve's carried verdicts (called post-apply). With
     ``ctx is None`` (an idle cycle: nothing pending) the carried set is
-    empty — the strongest warm state there is. Returns the carried job
-    count (stats)."""
+    empty — the strongest warm state there is. After a SUBSET solve
+    (``ctx.subset_jobs``) carried entries OUTSIDE the subset keep their
+    verdicts — re-pinned to the current clone where narrow bind churn
+    re-minted it, like :func:`advance_noop` — and subset jobs'
+    entries are superseded by this solve's unassigned rows. Returns the
+    carried job count (stats)."""
     ws = warm_state_of(ssn.cache)
     if ws is None:
         return 0
     carried: Dict[str, tuple] = {}
     has_releasing = True
+    subset = getattr(ctx, "subset_jobs", None) if ctx is not None else None
+    if subset is not None and ws.valid:
+        for uid, (obj, ver, remainder) in ws.carried.items():
+            if uid in subset:
+                continue
+            job = ssn.jobs.get(uid)
+            if job is None:
+                continue
+            if job is not obj or job._ver != ver:
+                carried[uid] = (job, job._ver, remainder)
+            else:
+                carried[uid] = (obj, ver, remainder)
     if ctx is None:
         # Idle: no pending tasks at all. Releasing presence from the
         # tensorize cache's freshly absorbed columns.
@@ -311,5 +462,8 @@ def save_warm_state(ssn, ctx, assigned) -> int:
     ws.has_releasing = has_releasing
     ws.snap_gen = getattr(ssn, "snap_gen", 0)
     ws.mesh_token = _layout_token()
+    ws.pending_dirty_jobs.clear()
+    ws.pending_dirty_nodes.clear()
+    ws.pending_narrow.clear()
     ws.valid = True
     return len(carried)

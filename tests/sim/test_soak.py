@@ -259,3 +259,43 @@ def test_soak_cli_exit_code_on_trip(tmp_path, monkeypatch):
         "--trace", str(tmp_path / "s.jsonl"),
     ])
     assert rc == 4
+
+
+def test_stranded_carried_backlog_trips_growth_detector():
+    """A subset-solve bug that STRANDS carried tasks (each storm
+    leaves a residue the rotation never retires) shows up as a
+    sustained linear climb in the carried_backlog_depth watermark —
+    the production policy must trip it, with a usable bisect window."""
+    from kube_batch_tpu.sim.soak import GROWTH_POLICY
+
+    rng = random.Random(17)
+    policy = GROWTH_POLICY["carried_backlog_depth"]
+    # Bursty congestion riding a leak: storms spike the depth, drains
+    # pull it back, but every cycle strands ~0.25 jobs for good.
+    stranded = [
+        0.25 * c + (120.0 if c % 100 < 8 else 0.0)
+        + rng.uniform(0, 10.0)
+        for c in range(2000)
+    ]
+    windows = make_windows({"carried_backlog_depth": stranded})
+    result = check_growth(windows, "carried_backlog_depth", policy)
+    assert result is not None and result.tripped, result
+    assert result.suspect_cycles is not None
+
+
+def test_bursty_but_draining_backlog_does_not_trip():
+    """Legitimate congestion: storms push the carried depth high and
+    the micro steady state drains it back — high and bursty but flat.
+    The policy's floors must let this soak pass."""
+    from kube_batch_tpu.sim.soak import GROWTH_POLICY
+
+    rng = random.Random(19)
+    policy = GROWTH_POLICY["carried_backlog_depth"]
+    draining = [
+        (200.0 - 2.5 * (c % 100) if c % 100 < 80 else 0.0)
+        + rng.uniform(0, 10.0)
+        for c in range(2000)
+    ]
+    windows = make_windows({"carried_backlog_depth": draining})
+    result = check_growth(windows, "carried_backlog_depth", policy)
+    assert result is not None and not result.tripped, result

@@ -273,6 +273,7 @@ class TestWarmMeshToken:
         ws.carried = {}
         return types.SimpleNamespace(
             cache=cache, snap_gen=5, dirty_nodes={"n1"},
+            dirty_jobs=set(), dirty_jobs_narrow=set(), jobs={}, queues={},
         )
 
     def test_plan_falls_back_on_layout_change(self, monkeypatch):

@@ -132,6 +132,32 @@ class _ScenarioDriver:
                         ),
                         group_name=name,
                     ))
+            elif kind == "gang-head":
+                # First HEAD pods of a split gang: the group object and
+                # the head arrive this cycle, the tail next cycle — the
+                # micro/periodic boundary shape for gang arrivals.
+                name, size, min_member, queue, cpu, mem, head = payload
+                cache.add_pod_group(build_pod_group(
+                    name, namespace="ns", min_member=min_member, queue=queue,
+                ))
+                for i in range(head):
+                    cache.add_pod(build_pod(
+                        "ns", f"{name}-p{i}", "", PodPhase.PENDING,
+                        build_resource_list(
+                            cpu=f"{cpu}m", memory=f"{mem}Mi"
+                        ),
+                        group_name=name,
+                    ))
+            elif kind == "gang-tail":
+                name, size, min_member, queue, cpu, mem, head = payload
+                for i in range(head, size):
+                    cache.add_pod(build_pod(
+                        "ns", f"{name}-p{i}", "", PodPhase.PENDING,
+                        build_resource_list(
+                            cpu=f"{cpu}m", memory=f"{mem}Mi"
+                        ),
+                        group_name=name,
+                    ))
             elif kind == "complete":
                 bound = self._bound_tasks(cache)
                 if bound:
@@ -227,13 +253,87 @@ class TestWarmColdBitParity:
         assert set(outcomes[1:]) <= {"solve", "noop"}, outcomes
         assert "solve" in outcomes[1:]
 
-    def test_disqualifying_events_fall_back_labeled(self):
+    def test_churn_events_fold_into_subset_not_full_solve(self):
+        """Third-party churn (node death, mutated carried jobs, queue
+        budget moves) no longer voids the whole warm plan: the affected
+        carried work is FORCED into the rank-stable subset and
+        re-solved, so the stream keeps engaging. The only full-solve
+        outcomes left in a churny stream are the first cycle's cold
+        start and a node event landing on a tick with no pending work
+        anywhere."""
         driver = _ScenarioDriver(9)
         script = driver.script(["wave", "arrival", "node-death"], 8)
         _, outcomes = _ScenarioDriver(9).run(script, warm=True)
-        assert "node-dirty" in outcomes or "carried-changed" in outcomes, (
-            outcomes
+        assert outcomes[0] == "cold"
+        assert not set(outcomes[1:]) & {
+            "stale", "carried-changed", "deserved-changed",
+        }, outcomes
+        assert set(outcomes[1:]) <= {
+            "solve", "subset", "noop", "node-dirty",
+        }, outcomes
+        assert set(outcomes[1:]) & {"solve", "subset"}, outcomes
+
+
+class TestCongestedSubsetParity:
+    """Congested-regime scripts: the opening wave over-subscribes a
+    2-node cluster so a real carried backlog forms, and new arrivals
+    interleave with it every cycle — the warm machine must answer with
+    rank-stable SUBSET solves, not full re-solves. Contract: placements
+    and idle accounting stay bit-identical to KBT_WARM=0 across every
+    cycle, AND the subset path actually engages (a script that never
+    reaches ``subset`` proves nothing about it)."""
+
+    def _run_script_pair(self, seed, script, nodes):
+        warm_states, warm_outcomes = _ScenarioDriver(
+            seed, nodes=nodes
+        ).run(script, warm=True)
+        cold_states, _ = _ScenarioDriver(
+            seed, nodes=nodes
+        ).run(script, warm=False)
+        for c, (w, k) in enumerate(zip(warm_states, cold_states)):
+            assert w == k, (
+                f"seed {seed}: warm/cold state diverged at cycle {c} "
+                f"(warm outcome {warm_outcomes[c]!r})"
+            )
+        return warm_outcomes
+
+    def _run_pair(self, seed, kinds, cycles, nodes=2):
+        driver = _ScenarioDriver(seed, nodes=nodes)
+        script = driver.script(kinds, cycles)
+        return self._run_script_pair(seed, script, nodes)
+
+    def test_carried_new_interleave_parity(self):
+        outcomes = self._run_pair(21, ["wave", "arrival"], 10)
+        assert "subset" in outcomes, outcomes
+
+    def test_preempt_mid_backlog_parity(self):
+        outcomes = self._run_pair(23, ["wave", "arrival", "evict"], 10)
+        assert "subset" in outcomes, outcomes
+
+    def test_completion_churn_mid_backlog_parity(self):
+        outcomes = self._run_pair(
+            25, ["wave", "arrival", "completion"], 12
         )
+        assert "subset" in outcomes, outcomes
+
+    def test_gang_spanning_cycle_boundary_parity(self):
+        # One gang's pods arrive split across a cycle boundary: the
+        # head lands gated below min_member while a backlog is carried,
+        # the tail completes the gang one cycle later, and completions
+        # then free capacity so the backlog drains through subset
+        # solves.
+        script = [
+            [("gang", ("gb0", 6, 2, "q0", 2000, 1024)),
+             ("gang", ("gb1", 6, 2, "q1", 2000, 1024)),
+             ("gang-head", ("gs", 4, 4, "q0", 500, 512, 2))],
+            [("gang-tail", ("gs", 4, 4, "q0", 500, 512, 2))],
+            [("complete", 0), ("complete", 1)],
+            [("gang", ("gn", 2, 1, "q1", 500, 512))],
+            [("complete", 2)],
+            [],
+        ]
+        outcomes = self._run_script_pair(29, script, nodes=2)
+        assert "subset" in outcomes, outcomes
 
 
 class TestNarrowLedger:
@@ -455,14 +555,16 @@ class TestMicroCycles:
         assert cache.wait_for_side_effects(timeout=30.0)
         cache.shutdown()
 
-    def test_micro_defers_when_warm_cannot_engage(self):
+    def test_micro_places_through_node_churn(self):
+        """Third-party node churn used to void the warm plan and defer
+        the whole micro cycle; under the congested-regime fold the
+        carried verdicts are forced into the subset instead, and the
+        new pod still binds within the micro cycle it arrived in."""
         cache = TestNarrowLedger._cluster(TestNarrowLedger())
         sched = self._sched(cache)
         sched.run_once()
         assert cache.wait_for_side_effects(timeout=30.0)
         assert cache.wait_for_bookkeeping(timeout=30.0)
-        # Third-party node churn voids the warm plan: the micro cycle
-        # must place NOTHING and leave the work to the periodic cycle.
         node = cache.nodes["nn1"]
         cache.update_node(node.node, node.node)
         cache.add_pod_group(build_pod_group(
@@ -474,10 +576,77 @@ class TestMicroCycles:
             group_name="pgd",
         ))
         assert sched.run_micro()
-        assert last_stats.get("micro_deferred") == "node-dirty"
+        assert "micro_deferred" not in last_stats, last_stats
+        assert last_stats.get("warm_outcome") in ("solve", "subset")
+        assert last_stats.get("placed") == 1
+        assert cache.wait_for_side_effects(timeout=30.0)
+        cache.shutdown()
+
+    def test_micro_defers_when_warm_cannot_engage(self):
+        cache = TestNarrowLedger._cluster(TestNarrowLedger())
+        sched = self._sched(cache)
+        sched.run_once()
+        assert cache.wait_for_side_effects(timeout=30.0)
+        assert cache.wait_for_bookkeeping(timeout=30.0)
+        # Invalidate the warm state (what a failed commit or an
+        # explicit poke does): with no carried verdicts at all the
+        # micro cycle must place NOTHING and leave the work to the
+        # periodic cycle.
+        from kube_batch_tpu.solver import warm
+
+        warm.invalidate(cache)
+        cache.add_pod_group(build_pod_group(
+            "pgd", namespace="ns", min_member=1, queue="q0",
+        ))
+        cache.add_pod(build_pod(
+            "ns", "pgd-p0", "", PodPhase.PENDING,
+            build_resource_list(cpu="250m", memory="256Mi"),
+            group_name="pgd",
+        ))
+        assert sched.run_micro()
+        assert last_stats.get("micro_deferred") == "cold"
         assert "placed" not in last_stats
         # The following periodic cycle picks the pod up.
         sched.run_once()
+        assert last_stats.get("placed") == 1
+        assert cache.wait_for_side_effects(timeout=30.0)
+        cache.shutdown()
+
+    def test_deferred_micro_dirt_folds_forward(self):
+        """A deferring micro cycle has already DRAINED the cache's
+        dirty ledgers through its session; note_deferred must fold
+        that dirt (and the consumed snapshot generation) back into the
+        warm state, or one defer would strand every following micro
+        cycle on ``stale`` until the next periodic solve."""
+        cache = TestNarrowLedger._cluster(TestNarrowLedger())
+        sched = self._sched(cache)
+        sched.run_once()
+        assert cache.wait_for_side_effects(timeout=30.0)
+        assert cache.wait_for_bookkeeping(timeout=30.0)
+        from kube_batch_tpu.solver import warm
+
+        ws = warm.warm_state_of(cache)
+        assert ws is not None and ws.valid
+        # Force one defer with the warm state still valid (the
+        # releasing gate), with a new pod pending.
+        ws.has_releasing = True
+        cache.add_pod_group(build_pod_group(
+            "pgf", namespace="ns", min_member=1, queue="q0",
+        ))
+        cache.add_pod(build_pod(
+            "ns", "pgf-p0", "", PodPhase.PENDING,
+            build_resource_list(cpu="250m", memory="256Mi"),
+            group_name="pgf",
+        ))
+        assert sched.run_micro()
+        assert last_stats.get("micro_deferred") == "releasing"
+        assert "placed" not in last_stats
+        # Gate lifts: the NEXT micro cycle must engage and place the
+        # pod the deferred cycle drained — not report stale.
+        ws.has_releasing = False
+        assert sched.run_micro()
+        assert "micro_deferred" not in last_stats, last_stats
+        assert last_stats.get("warm_outcome") in ("solve", "subset")
         assert last_stats.get("placed") == 1
         assert cache.wait_for_side_effects(timeout=30.0)
         cache.shutdown()
@@ -572,6 +741,145 @@ class TestIncrementalSnapshotParity:
         cache.shutdown()
 
 
+class TestMicroVerificationSkip:
+    """Micro snapshots (KBT_MICRO_VERIFY=ledger, the r17 default) skip
+    the O(n) ``_ver`` compare and verify only ledger-named positions +
+    the arrival tail. Pinned here: ledger-named churn IS re-verified on
+    the micro path, and an out-of-band poke that bypasses every ledger
+    — which nothing in-tree does — is reconciled by the next PERIODIC
+    snapshot's full verification, never lost."""
+
+    def _cluster(self):
+        cache = make_cache()
+        cache.add_queue(build_queue("q0", weight=1))
+        cache.add_node(build_node(
+            "n0", build_resource_list(cpu="8", memory="32Gi", pods=110),
+        ))
+        return cache
+
+    def test_ledger_named_churn_verified_on_micro_path(self):
+        cache = self._cluster()
+        cache.add_pod_group(build_pod_group(
+            "pg0", namespace="ns", min_member=1, queue="q0",
+        ))
+        old_pod = build_pod(
+            "ns", "pg0-p0", "n0", PodPhase.RUNNING,
+            build_resource_list(cpu="500m", memory="512Mi"),
+            group_name="pg0",
+        )
+        cache.add_pod(old_pod)
+        snap0 = cache.snapshot()
+        before = cache.snap_ledger_verifies
+        # Watch event (pod resize) stamps the dirty ledger: the micro
+        # fast verification must re-clone exactly that position.
+        bigger = build_pod(
+            "ns", "pg0-p0", "n0", PodPhase.RUNNING,
+            build_resource_list(cpu="1500m", memory="512Mi"),
+            group_name="pg0",
+        )
+        bigger.metadata.uid = old_pod.metadata.uid
+        cache.update_pod(old_pod, bigger)
+        snap1 = cache.snapshot(micro=True)
+        assert cache.snap_ledger_verifies == before + 1
+        assert snap1.nodes["n0"].idle.milli_cpu == (
+            snap0.nodes["n0"].idle.milli_cpu - 1000.0
+        )
+        cache.shutdown()
+
+    def test_out_of_band_poke_reconciled_by_periodic_full(self):
+        cache = self._cluster()
+        snap0 = cache.snapshot()
+        full_before = cache.snap_full_verifies
+        # Direct mutator poke: bumps the mirror ``_ver`` but stamps NO
+        # ledger — outside every in-tree write path.
+        from kube_batch_tpu.api import TaskInfo
+
+        pod = build_pod(
+            "ns", "poke", "n0", PodPhase.RUNNING,
+            build_resource_list(cpu="1", memory="1Gi"),
+        )
+        with cache.mutex:
+            cache.nodes["n0"].add_task(TaskInfo(pod))
+        # The micro snapshot's ledger verification has no name to
+        # recheck: it reuses the stale clone (the documented trade).
+        snap_micro = cache.snapshot(micro=True)
+        assert snap_micro.nodes["n0"].idle.milli_cpu == (
+            snap0.nodes["n0"].idle.milli_cpu
+        )
+        # The periodic snapshot always runs the full compare and
+        # reconciles: the reconciliation authority never moved.
+        snap_full = cache.snapshot()
+        assert cache.snap_full_verifies > full_before
+        assert snap_full.nodes["n0"].idle.milli_cpu == (
+            snap0.nodes["n0"].idle.milli_cpu - 1000.0
+        )
+        cache.shutdown()
+
+
+class TestPluginFoldReuse:
+    """Cross-session plugin fold reuse (KBT_FOLD_REUSE, default on):
+    drf/proportion per-job fold results persist in the cache's
+    ``plugin_fold`` store and only churned jobs re-fold. Pinned:
+    placements are bit-identical with the store disabled."""
+
+    def test_fold_reuse_bit_parity(self):
+        driver = _ScenarioDriver(31)
+        script = driver.script(
+            ["wave", "arrival", "completion", "evict"], 10
+        )
+        states_on, _ = _ScenarioDriver(31).run(script, warm=True)
+        prev = _env("KBT_FOLD_REUSE", "0")
+        try:
+            states_off, _ = _ScenarioDriver(31).run(script, warm=True)
+        finally:
+            _env("KBT_FOLD_REUSE", prev)
+        for c, (a, b) in enumerate(zip(states_on, states_off)):
+            assert a == b, f"fold-reuse diverged at cycle {c}"
+
+    def test_fold_store_populated_and_reused(self):
+        cache = make_cache()
+        cache.add_queue(build_queue("q0", weight=1))
+        cache.add_node(build_node(
+            "n0", build_resource_list(cpu="8", memory="32Gi", pods=110),
+        ))
+        cache.add_pod_group(build_pod_group(
+            "pg0", namespace="ns", min_member=1, queue="q0",
+        ))
+        cache.add_pod(build_pod(
+            "ns", "pg0-p0", "", PodPhase.PENDING,
+            build_resource_list(cpu="500m", memory="512Mi"),
+            group_name="pg0",
+        ))
+        action, _ = get_action("allocate_tpu")
+        tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+        ssn = open_session(cache, tiers)
+        action.execute(ssn)
+        close_session(ssn)
+        assert cache.wait_for_side_effects(timeout=30.0)
+        assert cache.wait_for_bookkeeping(timeout=30.0)
+        assert cache.plugin_fold, "fold store empty after a session"
+        # Session 2 re-folds the churned job (its pod bound) and pins
+        # the settled clone; with NOTHING changing after that, session
+        # 3 must reuse the folded attrs wholesale, by identity.
+        ssn = open_session(cache, tiers)
+        action.execute(ssn)
+        close_session(ssn)
+        assert cache.wait_for_side_effects(timeout=30.0)
+        attrs2 = {
+            uid: ent[2]
+            for uid, ent in cache.plugin_fold["drf"]["entries"].items()
+        }
+        assert attrs2, "drf fold entries empty after steady session"
+        ssn = open_session(cache, tiers)
+        action.execute(ssn)
+        close_session(ssn)
+        assert cache.wait_for_side_effects(timeout=30.0)
+        entries3 = cache.plugin_fold["drf"]["entries"]
+        for uid, attr in attrs2.items():
+            assert entries3[uid][2] is attr, uid
+        cache.shutdown()
+
+
 class TestWarmRetraceGuard:
     def test_zero_new_jits_on_warm_path(self):
         """Steady warm cycles on the jax backend must not mint solver
@@ -618,6 +926,79 @@ class TestWarmRetraceGuard:
                 burst(r)
                 cycle()
                 assert last_stats.get("warm_outcome") in ("solve", "noop")
+            assert jit_compilation_count() == baseline
+        finally:
+            _env("KBT_SOLVER", prev)
+            cache.shutdown()
+
+    def test_zero_new_jits_on_subset_path(self):
+        """Congested steady state: the rotating rank-stable subset
+        solves must reuse the shape buckets the first subset rounds
+        compiled — a carried backlog being re-solved every cycle must
+        not mint new jit variants per round, or the micro path's
+        latency budget is spent in XLA."""
+        prev = _env("KBT_SOLVER", "jax")
+        try:
+            from kube_batch_tpu.solver import jit_compilation_count
+
+            cache = make_cache()
+            cache.add_queue(build_queue("q0", weight=1))
+            for j in range(2):
+                cache.add_node(build_node(
+                    f"n{j}",
+                    build_resource_list(cpu="4", memory="16Gi", pods=110),
+                ))
+            action, _ = get_action("allocate_tpu")
+            tiers = make_tiers(*DEFAULT_TIERS_ARGS)
+
+            def burst(r, size, cpu):
+                cache.add_pod_group(build_pod_group(
+                    f"w{r}", namespace="ns", min_member=1, queue="q0",
+                ))
+                for i in range(size):
+                    cache.add_pod(build_pod(
+                        "ns", f"w{r}-p{i}", "", PodPhase.PENDING,
+                        build_resource_list(
+                            cpu=f"{cpu}m", memory="256Mi"
+                        ),
+                        group_name=f"w{r}",
+                    ))
+
+            def complete(n):
+                with cache.mutex:
+                    tasks = [
+                        t for key in sorted(cache.jobs)
+                        for t in cache.jobs[key].tasks.values()
+                        if t.status == TaskStatus.BINDING and t.node_name
+                    ]
+                for t in tasks[:n]:
+                    t.pod.status.phase = PodPhase.SUCCEEDED
+                    cache.delete_pod(t.pod)
+
+            def cycle():
+                ssn = open_session(cache, tiers)
+                action.execute(ssn)
+                close_session(ssn)
+                assert cache.wait_for_side_effects(timeout=30.0)
+                assert cache.wait_for_bookkeeping(timeout=30.0)
+
+            # Fill the 8000m cluster and overflow it: a 4-pod carried
+            # backlog forms, and every following round completes 2
+            # bound pods + lands a 2-pod gang — steady congestion.
+            burst(0, 8, 1000)
+            burst("ov", 4, 1000)
+            cycle()
+            # Warm-up rounds compile every bucket the rotation uses.
+            for r in range(1, 5):
+                complete(2)
+                burst(r, 2, 1000)
+                cycle()
+            baseline = jit_compilation_count()
+            for r in range(5, 10):
+                complete(2)
+                burst(r, 2, 1000)
+                cycle()
+                assert last_stats.get("warm_outcome") == "subset"
             assert jit_compilation_count() == baseline
         finally:
             _env("KBT_SOLVER", prev)

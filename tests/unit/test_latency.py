@@ -400,6 +400,79 @@ def test_audit_ring_bounds_and_deterministic_dump(tmp_path):
     assert open(path).read().splitlines() == lines
 
 
+def test_micro_defer_restamps_requeued_and_splits_stages():
+    """A deferred micro cycle placed nothing: its arrival batch must be
+    re-stamped ``requeued`` (reason ``micro-defer:<outcome>``) so the
+    wait until the periodic pickup is attributed to the defer — the
+    requeue RESTARTS the clock, and the eventual placement's total
+    measures from the requeue, not the first arrival."""
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.solver import warm
+
+    cache = _cache()
+    cache.add_queue(build_queue("q0", weight=1))
+    cache.add_node(build_node(
+        "n0", build_resource_list(cpu="8", memory="32Gi", pods=110),
+    ))
+    conf = (
+        'actions: "allocate_tpu"\n'
+        "tiers:\n"
+        "- plugins:\n"
+        "  - name: priority\n"
+        "  - name: gang\n"
+        "  - name: conformance\n"
+        "- plugins:\n"
+        "  - name: drf\n"
+        "  - name: predicates\n"
+        "  - name: proportion\n"
+        "  - name: nodeorder\n"
+    )
+    sched = Scheduler(cache, scheduler_conf=conf)
+    # After Scheduler init: the constructor installs its own clock.
+    clock = FakeClock(10.0)
+    LEDGER.configure(enabled=True, clock=clock.now)
+    cache.add_pod_group(build_pod_group(
+        "pg0", namespace="ns", min_member=1, queue="q0",
+    ))
+    cache.add_pod(build_pod(
+        "ns", "pg0-p0", "", PodPhase.PENDING,
+        build_resource_list(cpu="250m", memory="256Mi"),
+        group_name="pg0",
+    ))
+    sched.run_once()
+    assert cache.wait_for_side_effects(timeout=30.0)
+    assert cache.wait_for_bookkeeping(timeout=30.0)
+    # Void the warm state so the next micro cycle MUST defer (cold).
+    warm.invalidate(cache)
+    clock.tick(0.001)
+    cache.add_pod_group(build_pod_group(
+        "pgd", namespace="ns", min_member=1, queue="q0",
+    ))
+    cache.add_pod(build_pod(
+        "ns", "pgd-p0", "", PodPhase.PENDING,
+        build_resource_list(cpu="250m", memory="256Mi"),
+        group_name="pgd",
+    ))
+    requeues_before = LEDGER.requeues
+    assert sched.run_micro()
+    entry = next(
+        e for e in LEDGER._entries.values() if e.pod == "ns/pgd-p0"
+    )
+    assert entry.stage == "requeued"
+    assert entry.requeues == 1
+    assert entry.last_reason == "micro-defer:cold"
+    assert LEDGER.requeues == requeues_before + 1
+    # Periodic pickup 4 ms after the defer: total is measured from the
+    # requeue stamp (0.004s), NOT the original arrival (0.005s).
+    clock.tick(0.004)
+    sched.run_once()
+    assert cache.wait_for_side_effects(timeout=30.0)
+    done = next(d for d in LEDGER._done if d["pod"] == "ns/pgd-p0")
+    assert done["requeues"] == 1
+    assert done["total_s"] == pytest.approx(0.004, abs=1e-6)
+    cache.shutdown()
+
+
 def test_audit_records_carry_no_wall_clock():
     """Replay byte-stability contract: nothing wall-clock-shaped in a
     record — only the ledger clock (vclock) and the cycle counter."""
