@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke latency-smoke diverge-smoke congest-smoke bench-compare verify kbtlint typecheck ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke latency-smoke diverge-smoke congest-smoke serving-smoke bench-compare verify kbtlint typecheck ci image clean
 
 all: native
 
@@ -204,6 +204,31 @@ congest-smoke:
 		--require-warm-subset --max-micro-defer-ratio 0.20 \
 		--fail-on-cycle-errors --quiet
 
+# Mixed serving+batch congested smoke (doc/design/serving.md): the
+# congest-smoke regime (micro cycles primary, 5 ms virtual ticks) with
+# a serving deployment stream layered on top — annotated SLO replicas
+# (50 ms arrival->bind target), replica churn, a 20% spot slice and two
+# topology tiers across the node pool, plus bind faults. Gates:
+# --require-serving-engaged (exit 10 if no SLO-targeted placement ever
+# happened — a vacuous run proves nothing), serving attainment >= 99%
+# and ZERO SLO violations on the virtual clock (exit 10), the serving
+# replica-floor invariant family armed every cycle (exit 1), cycle
+# errors fatal (exit 3). Batch-only bit-parity with the serving plugin
+# loaded is pinned separately by tests/sim/test_serving_sim.py.
+serving-smoke:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim \
+		--cycles 400 --seed 23 --backend dense \
+		--micro-every 8 --period 0.005 \
+		--nodes 64 --node-cpu-m 16000 --node-mem-mi 32768 \
+		--arrival-rate 12 --arrival-profile sustained \
+		--serving-rate 2 --serving-slo 0.05 --serving-churn 0.05 \
+		--reserved-frac 0.8 --node-tiers 2 \
+		--max-jobs-in-flight 4096 \
+		--faults "bind:0.03" \
+		--require-serving-engaged --min-serving-attainment 99 \
+		--max-serving-violations 0 \
+		--fail-on-cycle-errors --quiet
+
 # Placement-latency SLI smoke (doc/design/observability.md §5): a
 # short high-arrival burst run must (1) stamp pods at arrival and
 # carry them to bind-applied with a total-stage p99 present, (2) land
@@ -271,7 +296,7 @@ typecheck:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke diverge-smoke latency-smoke congest-smoke bench-compare
+ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke diverge-smoke latency-smoke congest-smoke serving-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

@@ -25,7 +25,7 @@ logger = logging.getLogger(__name__)
 # disables the memo for the cycle: an upstream-style
 # priority-vs-claimant verdict could flip a node from victimless to
 # victim-bearing for a later claimant, which the memo would hide.
-MEMO_SAFE_RECLAIMABLE = frozenset({"proportion", "gang", "conformance"})
+MEMO_SAFE_RECLAIMABLE = frozenset({"proportion", "gang", "conformance", "serving"})
 
 
 class ReclaimAction(Action):

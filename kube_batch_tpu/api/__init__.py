@@ -41,6 +41,28 @@ from .pod_info import (
     get_pod_resource_request,
     get_pod_resource_without_init_containers,
 )
+from .serving import (
+    CAPACITY_RESERVED,
+    CAPACITY_SPOT,
+    CAPACITY_TYPE_LABEL_KEY,
+    DEFAULT_NODE_CLASS,
+    MIN_TOPOLOGY_TIER_ANNOTATION_KEY,
+    REPLICA_FLOOR_ANNOTATION_KEY,
+    RESERVED_ONLY_ANNOTATION_KEY,
+    SLO_SECONDS_ANNOTATION_KEY,
+    TOPOLOGY_TIER_LABEL_KEY,
+    TPU_GENERATION_LABEL_KEY,
+    TPU_GENERATIONS_ANNOTATION_KEY,
+    WORKLOAD_CLASS_ANNOTATION_KEY,
+    WORKLOAD_CLASS_BATCH,
+    WORKLOAD_CLASS_SERVING,
+    NodeClass,
+    ServingSLO,
+    node_class_from_labels,
+    parse_serving_slo,
+    parse_workload_class,
+    slo_permits_node,
+)
 from .resource_info import (
     GPU_RESOURCE_NAME,
     MIN_MEMORY,

@@ -27,6 +27,13 @@ from .resource_info import (
     Resource,
     freeze_resource,
 )
+from .serving import (
+    WORKLOAD_CLASS_ANNOTATION_KEY,
+    WORKLOAD_CLASS_BATCH,
+    WORKLOAD_CLASS_SERVING,
+    ServingSLO,
+    parse_serving_slo,
+)
 from .types import TaskStatus, allocated_status, validate_status_update
 
 TaskID = str
@@ -132,6 +139,12 @@ class JobInfo:
         self.total_request = Resource.empty()
         self.creation_timestamp: float = 0.0
         self.pod_group: Optional[PodGroup] = None
+        # Workload class (api/serving.py): parsed from the first member
+        # pod carrying the workload-class annotation. Batch is the
+        # default and the pre-serving behavior; ``slo`` is None for
+        # batch jobs and an immutable ServingSLO for serving jobs.
+        self.workload_class: str = WORKLOAD_CLASS_BATCH
+        self.slo: Optional[ServingSLO] = None
         # Legacy gang source (reference job_info.go:153, deprecated but
         # part of the surface): a PodDisruptionBudget standing in for a
         # PodGroup.
@@ -198,6 +211,20 @@ class JobInfo:
         self.total_request.add(ti.resreq)
         if allocated_status(ti.status):
             self.allocated.add(ti.resreq)
+        # Serving-class opt-in: the first member carrying the
+        # workload-class annotation classifies the job (one dict get on
+        # the already-classified hot path; members of one job share
+        # annotations by construction).
+        if (
+            self.slo is None
+            and self.workload_class == WORKLOAD_CLASS_BATCH
+            and ti.pod.metadata.annotations.get(
+                WORKLOAD_CLASS_ANNOTATION_KEY
+            ) == WORKLOAD_CLASS_SERVING
+        ):
+            self._ver += 1
+            self.workload_class = WORKLOAD_CLASS_SERVING
+            self.slo = parse_serving_slo(ti.pod.metadata.annotations)
 
     def delete_task_info(self, ti: TaskInfo) -> None:
         """reference job_info.go:271-287"""
@@ -416,6 +443,8 @@ class JobInfo:
         info.node_selector = dict(self.node_selector)
         info.creation_timestamp = self.creation_timestamp
         info.pod_group = self.pod_group
+        info.workload_class = self.workload_class
+        info.slo = self.slo  # immutable; clones share
         info.pdb = self.pdb
         info.total_request = self.total_request.clone()
         info.allocated = self.allocated.clone()

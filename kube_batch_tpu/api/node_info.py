@@ -19,6 +19,7 @@ from .helpers import POD_KEY_CACHE_ATTR, pod_key
 from .job_info import TaskInfo
 from .objects import Node, Pod
 from .resource_info import Resource
+from .serving import DEFAULT_NODE_CLASS, NodeClass, node_class_from_labels
 from .types import NodePhase, TaskStatus
 
 logger = logging.getLogger(__name__)
@@ -61,12 +62,16 @@ class NodeInfo:
         # this). Keys the predicates plugin's static-node-verdict memo;
         # _ver cannot (it bumps on every bind).
         self._node_obj_ver = 0
+        # Node-class descriptor (api/serving.py): derived from labels
+        # here and on every set_node; immutable, so clones share it.
+        self.node_class: NodeClass = DEFAULT_NODE_CLASS
         if node is not None:
             self.name = node.name
             self.node = node
             self.idle = Resource.from_resource_list(node.status.allocatable)
             self.allocatable = Resource.from_resource_list(node.status.allocatable)
             self.capability = Resource.from_resource_list(node.status.capacity)
+            self.node_class = node_class_from_labels(node.metadata.labels)
         self._set_node_state(node)
 
     # -- state --------------------------------------------------------------
@@ -101,6 +106,7 @@ class NodeInfo:
             return
         self.name = node.name
         self.node = node
+        self.node_class = node_class_from_labels(node.metadata.labels)
         self.allocatable = Resource.from_resource_list(node.status.allocatable)
         self.capability = Resource.from_resource_list(node.status.capacity)
         self.idle = Resource.from_resource_list(node.status.allocatable)
@@ -305,6 +311,7 @@ class NodeInfo:
         res._node_obj_ver = self._node_obj_ver
         res.name = self.name
         res.node = self.node
+        res.node_class = self.node_class  # immutable; clones share
         res.state = NodeState(self.state.phase, self.state.reason)
         res.releasing = self.releasing.clone()
         res.idle = self.idle.clone()

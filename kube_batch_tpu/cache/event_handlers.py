@@ -227,13 +227,31 @@ class EventHandlersMixin:
             # mutex — the ledger is its own leaf lock) so arrival→bind
             # latency starts at the truthful moment the pod became
             # schedulable work (obs/latency.py).
-            from ..api import get_job_id
+            from ..api import (
+                WORKLOAD_CLASS_ANNOTATION_KEY,
+                get_job_id,
+                parse_serving_slo,
+                parse_workload_class,
+            )
             from ..obs.latency import LEDGER
 
+            annotations = pod.metadata.annotations
+            workload_class = (
+                parse_workload_class(annotations)
+                if WORKLOAD_CLASS_ANNOTATION_KEY in annotations
+                else "batch"
+            )
+            slo = (
+                parse_serving_slo(annotations)
+                if workload_class == "serving"
+                else None
+            )
             LEDGER.note_arrival(
                 pod.uid,
                 f"{pod.namespace}/{pod.name}",
                 get_job_id(pod) or pod.uid,
+                workload_class=workload_class,
+                slo_target=slo.target_seconds if slo is not None else None,
             )
 
     def _stored_task(self, ti: TaskInfo) -> TaskInfo:

@@ -113,6 +113,15 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             }
         except Exception:  # pragma: no cover - probes must not 500
             logger.exception("/debug/vars latency probe failed")
+        # Serving SLO surface (doc/design/serving.md): per-class
+        # attainment, violation count, budget burn, pending targeted
+        # placements — one curl answers "are serving SLOs being met".
+        # A duplicate of latency.serving at the top level so SLO health
+        # is greppable next to robustness/integrity.
+        try:
+            out["serving"] = obs_latency.LEDGER.serving_summary()
+        except Exception:  # pragma: no cover - probes must not 500
+            logger.exception("/debug/vars serving probe failed")
         # Degraded-mode surface (doc/design/robustness.md): breaker
         # state machine + quarantine age, the last ladder descent, the
         # loop watchdog, and the leadership fence — one curl says

@@ -659,6 +659,34 @@ queue_fairness_drift = REGISTRY.register(
     ),
     ("queue",),
 )
+# Serving SLO accounting (kube_batch_tpu/obs/latency.py serving
+# extension, doc/design/serving.md): placement-latency SLO verdicts
+# per workload class, observed at the bind-applied seam.
+pod_slo_placements = REGISTRY.register(
+    Counter(
+        "pod_slo_placements_total",
+        "Placements of pods carrying a placement-latency SLO target, "
+        "by workload class and verdict (met = total latency within "
+        "the per-job target at bind-applied)",
+    ),
+    ("workload_class", "outcome"),
+)
+serving_slo_attainment = REGISTRY.register(
+    Gauge(
+        "serving_slo_attainment",
+        "Fraction of serving-class targeted placements that met their "
+        "placement-latency SLO (cumulative; 1.0 until the first "
+        "targeted placement)",
+    )
+)
+serving_slo_budget_burn = REGISTRY.register(
+    Gauge(
+        "serving_slo_budget_burn",
+        "Serving violation-budget burn: SLO misses divided by the "
+        "misses allowed at KBT_SERVING_ATTAINMENT_TARGET (>1 = the "
+        "attainment budget is blown)",
+    )
+)
 
 
 # Update helpers (reference metrics.go:122-170).

@@ -67,6 +67,11 @@ logger = logging.getLogger(__name__)
 
 LATENCY_ENV = "KBT_LATENCY"                 # "0" disables ledger + audit
 AUDIT_CAPACITY_ENV = "KBT_AUDIT_CAPACITY"   # audit ring size (records)
+# Serving SLO-attainment target (fraction of serving placements that
+# must meet their per-job latency target). Defines the violation
+# budget: misses allowed = (1 - target) x targeted placements.
+SERVING_TARGET_ENV = "KBT_SERVING_ATTAINMENT_TARGET"
+DEFAULT_SERVING_TARGET = 0.99
 DEFAULT_AUDIT_CAPACITY = 4096
 # Completed-entry ring served by /debug/latency (forensics only — the
 # percentile sketches are the durable aggregate).
@@ -81,6 +86,16 @@ QUANTILES = (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))
 
 def latency_enabled_from_env() -> bool:
     return os.environ.get(LATENCY_ENV, "1") != "0"
+
+
+def serving_target_from_env() -> float:
+    try:
+        t = float(os.environ.get(
+            SERVING_TARGET_ENV, DEFAULT_SERVING_TARGET
+        ))
+    except ValueError:
+        return DEFAULT_SERVING_TARGET
+    return min(1.0, max(0.0, t))
 
 
 class _PodEntry:
@@ -190,6 +205,9 @@ class PlacementLedger:
             "_entries", "_by_job", "_jobs", "_sketches", "_done",
             "stamped", "applied", "bind_failures", "requeues",
             "gang_samples", "_cycle", "_cycle_kind",
+            "_serving_jobs", "_slo_targets", "_serving_pending",
+            "_job_slo_applied", "_job_slo_missed", "_slo_counts",
+            "_serving_arrival", "_serving_target",
         ))
 
     # -- lifecycle -----------------------------------------------------------
@@ -213,6 +231,25 @@ class PlacementLedger:
             self.gang_samples = 0
             self._cycle = 0
             self._cycle_kind = "periodic"
+            # -- serving SLO accounting (doc/design/serving.md) --------
+            # Jobs classified serving at arrival; jobs with a latency
+            # target additionally keyed into _slo_targets.
+            self._serving_jobs: set = set()
+            self._slo_targets: Dict[str, float] = {}
+            # uid -> SLO deadline (arrival/restart ts + target) for the
+            # pending serving entries — the serving-pressure signal.
+            self._serving_pending: Dict[str, float] = {}
+            # Per-job targeted placements and misses (the preempt
+            # gate's violation-budget input; GC'd with the job).
+            self._job_slo_applied: Dict[str, int] = {}
+            self._job_slo_missed: Dict[str, int] = {}
+            # Per-class [targeted placements, met, missed].
+            self._slo_counts: Dict[str, List[int]] = {}
+            # Set on a serving arrival, consumed by the scheduler's
+            # micro coalescing window (serving arrivals ride the
+            # minimum window — highest coalescing priority).
+            self._serving_arrival = False
+            self._serving_target = serving_target_from_env()
 
     def configure(
         self,
@@ -251,9 +288,18 @@ class PlacementLedger:
 
     # -- stage transitions ---------------------------------------------------
 
-    def note_arrival(self, uid: str, pod_key: str, job: str) -> None:
+    def note_arrival(
+        self,
+        uid: str,
+        pod_key: str,
+        job: str,
+        workload_class: str = "batch",
+        slo_target: Optional[float] = None,
+    ) -> None:
         """A pending pod of ours landed in the mirror (the cache event
-        handler's add_pod seam). Idempotent per uid."""
+        handler's add_pod seam). Idempotent per uid. Serving pods carry
+        their class + placement-latency target so the ledger can keep
+        per-class SLO accounting and the serving-pressure signal."""
         if not self.enabled:
             return
         with self._lock:
@@ -263,6 +309,12 @@ class PlacementLedger:
             self._entries[uid] = _PodEntry(uid, pod_key, job, now)
             self._track_locked(uid, job, now)
             self.stamped += 1
+            if workload_class == "serving":
+                self._serving_jobs.add(job)
+                self._serving_arrival = True
+                if slo_target is not None and slo_target > 0:
+                    self._slo_targets[job] = float(slo_target)
+                    self._serving_pending[uid] = now + float(slo_target)
 
     def _track_locked(self, uid: str, job: str, now: float) -> None:
         """Register one entry in the job index + wait record (caller
@@ -366,10 +418,12 @@ class PlacementLedger:
         if not self.enabled:
             return
         metric_samples: List[Tuple[str, str, str, float]] = []
+        slo_sample: Optional[Tuple[str, bool]] = None
         with self._lock:
             e = self._entries.pop(uid, None)
             if e is None:
                 return
+            self._serving_pending.pop(uid, None)
             now = self._clock()
             placed = e.placed_ts if e.placed_ts is not None else (
                 e.dispatch_ts if e.dispatch_ts is not None else now
@@ -388,6 +442,27 @@ class PlacementLedger:
                 self._stage_stats(queue, kind, stage).add(v)
                 metric_samples.append((stage, queue, kind, v))
             self.applied += 1
+            # SLO verdict at the truthful bind-applied moment: a pod of
+            # a targeted job met its SLO iff total <= target.
+            target = self._slo_targets.get(e.job)
+            if target is not None:
+                cls = (
+                    "serving" if e.job in self._serving_jobs else "batch"
+                )
+                met = stages["total"] <= target
+                counts = self._slo_counts.get(cls)
+                if counts is None:
+                    counts = self._slo_counts[cls] = [0, 0, 0]
+                counts[0] += 1
+                counts[1 if met else 2] += 1
+                self._job_slo_applied[e.job] = (
+                    self._job_slo_applied.get(e.job, 0) + 1
+                )
+                if not met:
+                    self._job_slo_missed[e.job] = (
+                        self._job_slo_missed.get(e.job, 0) + 1
+                    )
+                slo_sample = (cls, met)
             members = self._by_job.get(e.job)
             if members is not None and uid in members:
                 members.remove(uid)
@@ -427,6 +502,18 @@ class PlacementLedger:
 
             for stage, q, kind, v in metric_samples:
                 metrics.observe_placement_latency(stage, q, kind, v)
+            if slo_sample is not None:
+                cls, met = slo_sample
+                metrics.pod_slo_placements.inc(
+                    (cls, "met" if met else "missed")
+                )
+                serving = self.serving_summary()
+                metrics.serving_slo_attainment.set(
+                    serving["attainment_pct"] / 100.0
+                )
+                metrics.serving_slo_budget_burn.set(
+                    serving["budget_burn"]
+                )
         except Exception:  # pragma: no cover - metrics must never kill
             logger.exception("placement latency metric update failed")
 
@@ -442,6 +529,7 @@ class PlacementLedger:
             e.restart(self._clock(), reason)
             self.bind_failures += 1
             self.requeues += 1
+            self._restart_serving_deadline(e)
             jw = self._jobs.get(e.job)
             if jw is not None:
                 jw.waiting_since = e.arrival_ts
@@ -463,11 +551,20 @@ class PlacementLedger:
                 self.stamped += 1
             e.restart(now, reason)
             self.requeues += 1
+            self._restart_serving_deadline(e)
+
+    def _restart_serving_deadline(self, e: _PodEntry) -> None:
+        """A restarted clock restarts the pod's SLO deadline too
+        (caller holds the lock)."""
+        target = self._slo_targets.get(e.job)
+        if target is not None:
+            self._serving_pending[e.uid] = e.arrival_ts + target
 
     # -- GC (the PR 6 metrics-GC pattern: no per-pod leak) -------------------
 
     def forget_pod(self, uid: str) -> None:
         with self._lock:
+            self._serving_pending.pop(uid, None)
             e = self._entries.pop(uid, None)
             if e is None:
                 return
@@ -481,6 +578,7 @@ class PlacementLedger:
                     # e.g. shadow-group pods filed under the pod uid).
                     self._by_job.pop(e.job, None)
                     self._jobs.pop(e.job, None)
+                    self._forget_job_serving_locked(e.job)
 
     def forget_job(self, job: str) -> None:
         """A job left the mirror (terminated-job cleanup): drop its
@@ -488,7 +586,103 @@ class PlacementLedger:
         with self._lock:
             for uid in self._by_job.pop(job, ()):
                 self._entries.pop(uid, None)
+                self._serving_pending.pop(uid, None)
             self._jobs.pop(job, None)
+            self._forget_job_serving_locked(job)
+
+    def _forget_job_serving_locked(self, job: str) -> None:
+        """Per-job serving state dies with the job (metrics-GC
+        pattern); the cumulative class counters are run-level and
+        stay."""
+        self._serving_jobs.discard(job)
+        self._slo_targets.pop(job, None)
+        self._job_slo_applied.pop(job, None)
+        self._job_slo_missed.pop(job, None)
+
+    # -- serving SLO surface (doc/design/serving.md) -------------------------
+
+    def serving_arrival_pending(self, consume: bool = True) -> bool:
+        """True when a serving pod arrived since the last check. The
+        scheduler's micro coalescing window consumes this to give
+        serving arrivals the minimum (highest-priority) window."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            pending = self._serving_arrival
+            if consume:
+                self._serving_arrival = False
+            return pending
+
+    def serving_pressure(self) -> bool:
+        """True when some pending serving pod has outlived its
+        placement-latency target — the early-fairness-pass trigger
+        (scheduler satellite: preempt/reclaim must not starve behind a
+        micro-cycle storm while a serving SLO is burning)."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            if not self._serving_pending:
+                return False
+            now = self._clock()
+            return any(
+                deadline <= now
+                for deadline in self._serving_pending.values()
+            )
+
+    def serving_budget_ok(self, job: str) -> bool:
+        """Whether ``job`` could absorb ONE more SLO miss and stay
+        inside its violation budget (misses allowed = (1 - target) x
+        targeted placements). The preempt/reclaim gate excludes serving
+        victims for which this is False — evicting one forces a
+        re-placement that is overwhelmingly likely to miss. Jobs
+        without a latency target always pass (the replica floor is
+        their only protection). Eviction-monotone and claimant-
+        independent by construction: the verdict reads only the
+        victim job's own cumulative counters, which evictions never
+        improve."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            if job not in self._slo_targets:
+                return True
+            applied = self._job_slo_applied.get(job, 0)
+            missed = self._job_slo_missed.get(job, 0)
+            allowed = (1.0 - self._serving_target) * applied
+            return missed + 1 <= allowed
+
+    def serving_summary(self) -> dict:
+        """Per-class SLO accounting (/debug/vars ``serving`` key, sim
+        report, bench): targeted placements, met/missed, attainment %,
+        violation-budget burn (missed / allowed; >1 = budget blown)."""
+        with self._lock:
+            classes = {
+                cls: {
+                    "placed": counts[0],
+                    "met": counts[1],
+                    "missed": counts[2],
+                    "attainment_pct": round(
+                        100.0 * counts[1] / counts[0], 3
+                    ) if counts[0] else 100.0,
+                }
+                for cls, counts in sorted(self._slo_counts.items())
+            }
+            serving = self._slo_counts.get("serving", [0, 0, 0])
+            allowed = (1.0 - self._serving_target) * serving[0]
+            return {
+                "target": self._serving_target,
+                "serving_jobs": len(self._serving_jobs),
+                "pending_targeted": len(self._serving_pending),
+                "classes": classes,
+                "attainment_pct": (
+                    round(100.0 * serving[1] / serving[0], 3)
+                    if serving[0] else 100.0
+                ),
+                "violations": serving[2],
+                "budget_burn": (
+                    round(serving[2] / allowed, 3) if allowed > 0
+                    else (float(serving[2]))
+                ),
+            }
 
     # -- aggregation ---------------------------------------------------------
 
@@ -524,6 +718,15 @@ class PlacementLedger:
         values = {"latency_entries": float(self.entry_count())}
         for queue, p99 in self.queue_p99_seconds().items():
             values[f"placement_p99:{queue}"] = round(p99, 6)
+        # Serving SLO-miss rate (cumulative; emitted only once serving
+        # placements exist so batch-only telemetry stays unchanged) —
+        # the soak drift detector bounds this series.
+        with self._lock:
+            serving = self._slo_counts.get("serving")
+        if serving and serving[0]:
+            values["serving_slo_miss_rate"] = round(
+                serving[2] / serving[0], 6
+            )
         return values
 
     def percentiles(self) -> dict:
@@ -578,6 +781,7 @@ class PlacementLedger:
             stage: stats["p99_s"]
             for stage, stats in self.stage_percentiles().items()
         }
+        counters["serving"] = self.serving_summary()
         return counters
 
     def snapshot(self) -> dict:

@@ -11,5 +11,6 @@ from . import (  # noqa: F401
     predicates,
     priority,
     proportion,
+    serving,
 )
 from .util import PredicateError, SessionPodLister

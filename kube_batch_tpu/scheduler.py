@@ -307,6 +307,20 @@ class Scheduler:
             )
         except ValueError:
             self.micro_batch_target = 64
+        # Early periodic fairness pass (doc/design/serving.md): when a
+        # pending serving pod has outlived its placement-latency target,
+        # or the warm carried backlog is deeper than this threshold, the
+        # think-time tail is cut short and the periodic cycle — the
+        # preempt/reclaim/fairness authority — runs NOW instead of after
+        # a micro-cycle storm finishes riding the period out (0
+        # disables the backlog trigger).
+        try:
+            self.serving_early_backlog = max(
+                0, int(os.environ.get("KBT_SERVING_EARLY_BACKLOG", "1024"))
+            )
+        except ValueError:
+            self.serving_early_backlog = 1024
+        self.early_fairness_passes = 0
         # Arrival-rate EWMA for the auto-tune (real-clock only: the
         # simulator drives micro cycles deterministically via
         # --micro-every and never enters _micro_wait, so this estimator
@@ -505,8 +519,12 @@ class Scheduler:
                             break
                 except Exception:
                     logger.exception("think-time side-effect drain failed")
-                if self.micro_enabled:
-                    self._micro_wait(stop, deadline)
+                if self.micro_enabled and self._micro_wait(stop, deadline):
+                    # Fairness pressure (serving SLO burning or deep
+                    # carried backlog): skip the rest of the think time
+                    # and run the periodic fairness pass immediately.
+                    self.early_fairness_passes += 1
+                    continue
                 remaining = max(0.0, deadline - time.perf_counter())
             clock.wait(stop, remaining)
         # Loop exit with tracing armed (KBT_TRACE_DIR): persist the
@@ -520,54 +538,110 @@ class Scheduler:
         self._micro_arrival.set()
 
     def _micro_tuned_window(self) -> float:
-        """The coalescing window for the next micro cycle. With
-        ``KBT_MICRO_BATCH_MS=auto`` (default) it is tuned from the
-        arrival-rate EWMA: wait just long enough to coalesce
-        ``KBT_MICRO_BATCH_TARGET`` arrivals, clamped to
-        [MIN_MS, MAX_MS] — a 10k/s storm batches into few large micro
-        cycles, a trickle places at the MIN_MS floor. A fixed value
+        """The coalescing window for the next micro cycle. Serving
+        arrivals always get the MINIMUM window — coalescing buys
+        throughput, and a serving pod pays for every waited millisecond
+        out of its placement-latency SLO budget, so they are the
+        highest-coalescing-priority class. With
+        ``KBT_MICRO_BATCH_MS=auto`` (default) the window is otherwise
+        sized from the ledger's MEASURED solve-stage p99
+        (obs/latency.py): waiting to coalesce is free exactly while the
+        wait stays below the per-cycle solve cost it amortizes, so the
+        window tracks what solves actually cost on this cluster rather
+        than a raw arrival-count guess. The arrival-rate EWMA remains
+        as the cold-start fallback until the ledger has applied
+        samples; clamped to [MIN_MS, MAX_MS] either way. A fixed value
         returns unchanged."""
+        from .obs.latency import LEDGER
+
+        if LEDGER.serving_arrival_pending():
+            self.micro_window_last = self.micro_batch_min
+            return self.micro_batch_min
         if not self.micro_batch_auto:
             self.micro_window_last = self.micro_batch_window
             return self.micro_batch_window
-        now = time.perf_counter()
-        dt = now - self._arrival_mark
-        if dt >= 0.5:
-            inst = self._arrival_count / dt
-            self._arrival_count = 0
-            self._arrival_mark = now
-            self._arrival_rate = (
-                inst
-                if self._arrival_rate == 0.0
-                else 0.7 * self._arrival_rate + 0.3 * inst
-            )
-        rate = self._arrival_rate
-        if rate <= 0.0:
-            window = self.micro_batch_min
-        else:
-            window = min(
-                self.micro_batch_max,
-                max(self.micro_batch_min, self.micro_batch_target / rate),
-            )
+        window = None
+        try:
+            solve = LEDGER.stage_percentiles().get("solve")
+            if solve and solve.get("count", 0) >= 8:
+                window = min(
+                    self.micro_batch_max,
+                    max(self.micro_batch_min, float(solve["p99_s"])),
+                )
+        except Exception:  # pragma: no cover - tuning must not wedge
+            window = None
+        if window is None:
+            now = time.perf_counter()
+            dt = now - self._arrival_mark
+            if dt >= 0.5:
+                inst = self._arrival_count / dt
+                self._arrival_count = 0
+                self._arrival_mark = now
+                self._arrival_rate = (
+                    inst
+                    if self._arrival_rate == 0.0
+                    else 0.7 * self._arrival_rate + 0.3 * inst
+                )
+            rate = self._arrival_rate
+            if rate <= 0.0:
+                window = self.micro_batch_min
+            else:
+                window = min(
+                    self.micro_batch_max,
+                    max(
+                        self.micro_batch_min,
+                        self.micro_batch_target / rate,
+                    ),
+                )
         self.micro_window_last = window
         return window
 
-    def _micro_wait(self, stop, deadline: float) -> None:
+    def _fairness_pressure(self) -> bool:
+        """Whether the periodic fairness pass should run EARLY: a
+        pending serving pod has outlived its placement-latency target
+        (its SLO is burning while only warm-plan micro placements run),
+        or the warm carried backlog is deeper than
+        ``KBT_SERVING_EARLY_BACKLOG`` (deep carried work starves behind
+        a micro-cycle storm — only the periodic preempt/reclaim sweep
+        can evict room for it)."""
+        from .obs.latency import LEDGER
+
+        if LEDGER.serving_pressure():
+            return True
+        if self.serving_early_backlog <= 0:
+            return False
+        ws = getattr(self.cache, "_warm_solve_state", None)
+        if ws is None or not getattr(ws, "valid", False):
+            return False
+        return len(ws.carried) > self.serving_early_backlog
+
+    def _micro_wait(self, stop, deadline: float) -> bool:
         """Think-time tail with event-driven placement: park on the
         arrival event until the period deadline; each wake-up runs one
         bounded micro cycle (after the coalescing window — auto-tuned
-        from the arrival rate by default — so a gang's pod burst lands
-        in one cycle), at most ``micro_max_per_period`` per period. A
-        micro-cycle error falls through to the normal per-cycle error
-        accounting — the periodic loop's backoff is not engaged (the
-        next periodic cycle is the recovery authority)."""
+        from the ledger's measured solve p99 by default — so a gang's
+        pod burst lands in one cycle), at most ``micro_max_per_period``
+        per period. A micro-cycle error falls through to the normal
+        per-cycle error accounting — the periodic loop's backoff is not
+        engaged (the next periodic cycle is the recovery authority).
+
+        Returns True when fairness pressure (serving SLO burning, deep
+        carried backlog — :meth:`_fairness_pressure`) says the periodic
+        pass must run NOW; the run loop then skips the rest of the
+        think time. The park is sliced so pressure that develops
+        between arrivals (a pending serving deadline expiring) is seen
+        within ~a quarter second, not at the period boundary."""
         used = 0
-        while not stop.is_set() and used < self.micro_max_per_period:
+        while not stop.is_set():
+            if self._fairness_pressure():
+                return True
+            if used >= self.micro_max_per_period:
+                return False
             left = deadline - time.perf_counter()
             if left <= 0:
-                return
-            if not self._micro_arrival.wait(timeout=left):
-                return
+                return False
+            if not self._micro_arrival.wait(timeout=min(left, 0.25)):
+                continue
             window = self._micro_tuned_window()
             if window > 0:
                 stop.wait(window)
@@ -577,6 +651,7 @@ class Scheduler:
                 self.run_micro()
             except Exception:  # pragma: no cover - guarded inside
                 logger.exception("micro cycle failed")
+        return False
 
     def run_micro(self) -> bool:
         """One event-driven micro cycle: the allocate fast path between

@@ -19,7 +19,12 @@ arrival→bind total p99 exceeded the bound, or the ledger stamped
 nothing; ``--max-micro-defer-ratio`` — too many micro cycles deferred
 to the periodic authority instead of placing, or no micro cycle ran
 at all; ``--require-warm-subset`` — no rank-stable subset solve ever
-engaged, so the storm proved nothing about the subset path).
+engaged, so the storm proved nothing about the subset path); 10 a
+serving-SLO assert failed (``--min-serving-attainment`` — serving
+placement-latency SLO attainment came in under the floor, or serving
+pods saw violations with ``--max-serving-violations``;
+``--require-serving-engaged`` — no SLO-targeted serving placement ever
+landed, so the mix proved nothing about the serving path).
 """
 
 from __future__ import annotations
@@ -84,6 +89,28 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--node-churn", type=float, default=0.0,
         help="per-cycle probability of a planned node add AND drain")
+    parser.add_argument(
+        "--serving-rate", type=float, default=0.0,
+        help="expected serving-deployment arrivals per cycle (0 keeps "
+             "the run batch-only and byte-identical to the pre-serving "
+             "event stream)")
+    parser.add_argument(
+        "--serving-slo", type=float, default=2.0, metavar="SECONDS",
+        help="placement-latency SLO target stamped on serving pods "
+             "(virtual seconds, tpu-batch/slo-seconds)")
+    parser.add_argument(
+        "--serving-churn", type=float, default=0.0,
+        help="per-cycle probability of replica churn on one running "
+             "serving job (rolling-restart analog: one replica deleted "
+             "+ a fresh Pending replacement)")
+    parser.add_argument(
+        "--reserved-frac", type=float, default=1.0,
+        help="fraction of nodes labeled reserved capacity (rest spot; "
+             "10%% granularity, only labeled when --serving-rate > 0)")
+    parser.add_argument(
+        "--node-tiers", type=int, default=1,
+        help="topology tiers cycled over node indices (node-class "
+             "labels, only with --serving-rate > 0)")
     parser.add_argument(
         "--backend", choices=("auto", "dense", "sparse", "native"),
         default="auto",
@@ -185,6 +212,21 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
         help="exit 8 unless at least one selection pass ran on the "
              "device-resident key matrix "
              "(solver_selection_device_total)")
+    parser.add_argument(
+        "--min-serving-attainment", type=float, default=None,
+        metavar="PCT",
+        help="exit 10 unless serving-class SLO attainment "
+             "(report.latency.serving, obs/latency.py) is at least PCT "
+             "percent")
+    parser.add_argument(
+        "--max-serving-violations", type=int, default=None, metavar="N",
+        help="exit 10 if more than N serving placements missed their "
+             "SLO target")
+    parser.add_argument(
+        "--require-serving-engaged", action="store_true",
+        help="exit 10 unless at least one SLO-targeted serving "
+             "placement landed — a mix that never exercised the "
+             "serving path proves nothing")
     parser.add_argument("--quiet", action="store_true",
                         help="suppress the JSON report on stdout")
 
@@ -231,6 +273,11 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         max_jobs_in_flight=ns.max_jobs_in_flight,
         node_add_rate=ns.node_churn,
         node_drain_rate=ns.node_churn,
+        serving_rate=ns.serving_rate,
+        serving_slo_s=ns.serving_slo,
+        serving_churn=ns.serving_churn,
+        reserved_frac=ns.reserved_frac,
+        node_tiers=ns.node_tiers,
     )
     # Replay normalization (cycles/seed/faults/period from the trace
     # header) is owned by ClusterSimulator.__init__ — single site.
@@ -435,4 +482,43 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
             return 9
+    if (
+        ns.min_serving_attainment is not None
+        or ns.max_serving_violations is not None
+        or ns.require_serving_engaged
+    ):
+        serving = (report.latency or {}).get("serving") or {}
+        cls = serving.get("classes", {}).get("serving", {})
+        placed = cls.get("placed", 0)
+        attainment = cls.get("attainment_pct", 100.0)
+        violations = serving.get("violations", 0)
+        if ns.require_serving_engaged and not placed:
+            print(
+                "sim: no SLO-targeted serving placement landed "
+                "(--require-serving-engaged)",
+                file=sys.stderr,
+            )
+            return 10
+        if (
+            ns.min_serving_attainment is not None
+            and attainment < ns.min_serving_attainment
+        ):
+            print(
+                f"sim: serving SLO attainment {attainment}% under the "
+                f"{ns.min_serving_attainment}% floor over {placed} "
+                f"targeted placements (--min-serving-attainment)",
+                file=sys.stderr,
+            )
+            return 10
+        if (
+            ns.max_serving_violations is not None
+            and violations > ns.max_serving_violations
+        ):
+            print(
+                f"sim: {violations} serving SLO violation(s) exceed "
+                f"the bound {ns.max_serving_violations} "
+                f"(--max-serving-violations)",
+                file=sys.stderr,
+            )
+            return 10
     return 0

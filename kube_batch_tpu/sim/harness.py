@@ -58,6 +58,7 @@ tiers:
   - name: predicates
   - name: proportion
   - name: nodeorder
+  - name: serving
 """
 
 # Backend name -> env overrides (None = unset). "auto" leaves the
@@ -1206,11 +1207,15 @@ class ClusterSimulator:
             q.metadata.creation_timestamp = self._next_ts(cycle)
             self.cluster.create_queue(q)
         elif kind == "node-add":
-            node = build_node(event["name"], build_resource_list(
-                cpu=f"{event['cpu_m']}m",
-                memory=f"{event['mem_mi']}Mi",
-                pods=110,
-            ))
+            node = build_node(
+                event["name"],
+                build_resource_list(
+                    cpu=f"{event['cpu_m']}m",
+                    memory=f"{event['mem_mi']}Mi",
+                    pods=110,
+                ),
+                labels=event.get("labels"),
+            )
             node.metadata.uid = f"uid-node-{event['name']}"
             node.metadata.creation_timestamp = self._next_ts(cycle)
             self.cluster.create_node(node)
@@ -1254,6 +1259,12 @@ class ClusterSimulator:
             SIM_NAMESPACE, pod_name, "", PodPhase.PENDING, dict(req),
             group_name=job,
         )
+        # Serving annotations (api/serving.py schema) ride the job
+        # spec, so churn/fault replacements inherit the class, SLO
+        # target and replica floor of the pods they replace.
+        extra = self._job_specs.get(job, {}).get("annotations")
+        if extra:
+            pod.metadata.annotations.update(extra)
         pod.metadata.creation_timestamp = ts
         self.cluster.create_pod(pod)
 

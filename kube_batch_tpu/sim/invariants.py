@@ -1,6 +1,6 @@
 """The kube-batch contract, checked after every simulated cycle.
 
-Four invariant families over the settled cache mirror + cluster truth:
+Five invariant families over the settled cache mirror + cluster truth:
 
 1. ``oversubscribe`` — per node, the resreq sum of resource-holding
    tasks fits allocatable, and the maintained idle/used aggregates
@@ -20,6 +20,12 @@ Four invariant families over the settled cache mirror + cluster truth:
    gang) and only when the queue GAINED allocation this cycle (deserved
    shrinks under node churn; holding old allocation is reclaim's
    business, not a scheduler bug).
+5. ``serving-floor`` — once a serving job has reached its replica
+   floor (``tpu-batch/replica-floor``, doc/design/serving.md), no
+   cycle may end with it below the floor: batch backfill's
+   preempt/reclaim must never take it there. Same degraded-exemption
+   shape as the gang family — a fault (node death, injected kill,
+   replica churn) may eat replicas; the scheduler may not.
 
 The checker is deliberately independent code: it recomputes everything
 from first principles (fresh water-fill, fresh per-node recount) so a
@@ -131,6 +137,10 @@ class InvariantChecker:
         self.diverged_uids: Dict[str, int] = {}
         self.diverged_nodes: Dict[str, int] = {}
         self.suppressed_total = 0
+        # Serving replica-floor high-water: job key -> the floor it
+        # reached. The floor binds only once reached (a deployment
+        # still scaling up is not "below floor").
+        self._floor_reached: Dict[str, int] = {}
 
     def mark_degraded(self, job_key: str, cycle: int) -> None:
         self.degraded.setdefault(job_key, cycle)
@@ -177,6 +187,7 @@ class InvariantChecker:
         with cache.mutex:
             self._check_nodes(cache, flag)
             self._check_gangs(cache, flag)
+            self._check_serving_floors(cache, flag)
             self._check_conservation(cache, namespace, flag)
             if self.check_shares:
                 self._check_queue_shares(cache, flag)
@@ -254,6 +265,41 @@ class InvariantChecker:
         for key in list(self.degraded):
             if key not in cache.jobs:
                 del self.degraded[key]
+
+    # -- 2b. serving replica floors ------------------------------------------
+
+    def _check_serving_floors(self, cache, flag) -> None:
+        """High-water floor check (gang-family shape): a serving job
+        that has REACHED its replica floor may never end a cycle below
+        it unless a fault degraded it (the harness marks fault kills
+        and churn deletes degraded; scheduler evictions are not
+        marked — a preempt/reclaim that takes a serving job below its
+        floor flags here)."""
+        for key, job in cache.jobs.items():
+            slo = getattr(job, "slo", None)
+            floor = slo.replica_floor if slo is not None else 0
+            if floor <= 0:
+                continue
+            ready = job.ready_task_num()
+            if ready >= floor:
+                self._floor_reached[key] = floor
+                if key in self.degraded and job.min_available <= 1:
+                    # Whole again (the gang family only clears entries
+                    # for minMember > 1 jobs it owns).
+                    del self.degraded[key]
+                continue
+            if key not in self._floor_reached:
+                continue  # still scaling up to its floor
+            if key in self.degraded:
+                continue  # fault/churn ate replicas; repair pending
+            flag(
+                "serving-floor", key,
+                f"serving job below reached replica floor: {ready} of "
+                f"floor {floor} hold resources",
+            )
+        for key in list(self._floor_reached):
+            if key not in cache.jobs:
+                del self._floor_reached[key]
 
     # -- 3. task conservation / double-bind ----------------------------------
 

@@ -113,6 +113,14 @@ DRIFT_POLICY: Dict[str, DriftPolicy] = {
     "sim_cycle_errors": DriftPolicy(
         bound=0.0, patience=1, warmup_exempt=True
     ),
+    # Serving SLO-miss rate (cumulative, obs/latency.py; emitted only
+    # once serving placements exist): attainment drift — a regression
+    # that slowly erodes serving placement latency — must fail a soak
+    # the same way fairness drift does. Bound = twice the default
+    # violation budget (1 - KBT_SERVING_ATTAINMENT_TARGET).
+    "serving_slo_miss_rate": DriftPolicy(
+        bound=0.02, patience=3, signed=False
+    ),
 }
 
 # Fraction of windows treated as warmup (jit compiles, pool growth).

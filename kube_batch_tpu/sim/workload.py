@@ -55,6 +55,28 @@ class WorkloadSpec:
     node_drain_rate: float = 0.0
     min_nodes: int = 4
     max_nodes: int = 64
+    # -- serving mix (doc/design/serving.md). serving_rate == 0 keeps
+    # the generator BYTE-IDENTICAL to the batch-only stream: no extra
+    # rng draws, no label/annotation keys on any event (the batch-only
+    # bit-parity contract rides on this).
+    serving_rate: float = 0.0       # expected serving arrivals per cycle
+    serving_sizes: Sequence[Tuple[int, float]] = (
+        (2, 0.5), (4, 0.35), (8, 0.15)
+    )
+    serving_duration: Tuple[int, int] = (32, 128)  # long-lived deployments
+    serving_slo_s: float = 2.0      # placement-latency target (virtual s)
+    serving_floor_frac: float = 0.5  # replica floor = ceil(size * frac)
+    serving_reserved_frac: float = 0.5  # P(job is spot-excluded)
+    serving_gen_frac: float = 0.25  # P(job pins one TPU generation)
+    serving_churn: float = 0.0      # per-cycle P(one replica churns)
+    serving_queue: str = "serving"
+    # Node classes (labels ride node-add events, only when a serving
+    # mix is configured): generation/tier cycle deterministically over
+    # the node index; reserved_frac of nodes are reserved, rest spot
+    # (10% granularity).
+    reserved_frac: float = 1.0
+    node_generations: Sequence[str] = ("v5e", "v5p")
+    node_tiers: int = 1
 
     def to_dict(self) -> dict:
         return {
@@ -74,6 +96,18 @@ class WorkloadSpec:
             "node_drain_rate": self.node_drain_rate,
             "min_nodes": self.min_nodes,
             "max_nodes": self.max_nodes,
+            "serving_rate": self.serving_rate,
+            "serving_sizes": [list(s) for s in self.serving_sizes],
+            "serving_duration": list(self.serving_duration),
+            "serving_slo_s": self.serving_slo_s,
+            "serving_floor_frac": self.serving_floor_frac,
+            "serving_reserved_frac": self.serving_reserved_frac,
+            "serving_gen_frac": self.serving_gen_frac,
+            "serving_churn": self.serving_churn,
+            "serving_queue": self.serving_queue,
+            "reserved_frac": self.reserved_frac,
+            "node_generations": list(self.node_generations),
+            "node_tiers": self.node_tiers,
         }
 
 
@@ -121,24 +155,57 @@ class WorkloadGenerator:
 
     # -- bootstrap -----------------------------------------------------------
 
+    def _serving_enabled(self) -> bool:
+        return self.spec.serving_rate > 0
+
     def initial_events(self) -> List[dict]:
+        queues = dict(self.spec.queues)
+        if self._serving_enabled():
+            queues.setdefault(self.spec.serving_queue, 2)
         events = [
             {"kind": "queue-add", "name": name, "weight": weight}
-            for name, weight in sorted(self.spec.queues.items())
+            for name, weight in sorted(queues.items())
         ]
         events.extend(
-            self._node_event(f"sim-node-{i:03d}")
+            self._node_event(f"sim-node-{i:03d}", i)
             for i in range(self.spec.nodes)
         )
         return events
 
-    def _node_event(self, name: str) -> dict:
-        return {
+    def _node_event(self, name: str, index: int) -> dict:
+        event = {
             "kind": "node-add",
             "name": name,
             "cpu_m": self.spec.node_cpu_m,
             "mem_mi": self.spec.node_mem_mi,
         }
+        if self._serving_enabled():
+            event["labels"] = self._node_labels(index)
+        return event
+
+    def _node_labels(self, index: int) -> Dict[str, str]:
+        """Node-class labels (api/serving.py schema), a pure function
+        of the node INDEX so churn-added nodes land in deterministic
+        classes under replay."""
+        from ..api import (
+            CAPACITY_SPOT,
+            CAPACITY_TYPE_LABEL_KEY,
+            TOPOLOGY_TIER_LABEL_KEY,
+            TPU_GENERATION_LABEL_KEY,
+        )
+
+        spec = self.spec
+        labels: Dict[str, str] = {}
+        if spec.node_generations:
+            labels[TPU_GENERATION_LABEL_KEY] = spec.node_generations[
+                index % len(spec.node_generations)
+            ]
+        if spec.node_tiers > 1:
+            labels[TOPOLOGY_TIER_LABEL_KEY] = str(index % spec.node_tiers)
+        reserved_slots = int(round(max(0.0, min(1.0, spec.reserved_frac)) * 10))
+        if index % 10 >= reserved_slots:
+            labels[CAPACITY_TYPE_LABEL_KEY] = CAPACITY_SPOT
+        return labels
 
     # -- per cycle -----------------------------------------------------------
 
@@ -176,9 +243,9 @@ class WorkloadGenerator:
             and n_nodes < spec.max_nodes
             and rng.random() < spec.node_add_rate
         ):
-            name = f"sim-node-{self._node_seq:03d}"
+            index = self._node_seq
             self._node_seq += 1
-            events.append(self._node_event(name))
+            events.append(self._node_event(f"sim-node-{index:03d}", index))
         if (
             spec.node_drain_rate > 0
             and n_nodes > spec.min_nodes
@@ -187,6 +254,14 @@ class WorkloadGenerator:
             victim = rng.choice(sorted(node_names))
             events.append(
                 {"kind": "node-remove", "name": victim, "reason": "drain"}
+            )
+
+        # Serving arrivals + replica churn FIRST (highest-priority
+        # class; their draws only happen when a serving mix is
+        # configured, so batch-only streams stay byte-identical).
+        if self._serving_enabled():
+            events.extend(
+                self._serving_events(cycle, running_since)
             )
 
         # Arrivals (profile-shaped; every random draw stays on the one
@@ -224,6 +299,108 @@ class WorkloadGenerator:
                 "cpu_m": int(cpu_m),
                 "mem_mi": int(mem_mi),
                 "duration": duration,
+            })
+        return events
+
+    # -- serving mix ---------------------------------------------------------
+
+    def _serving_events(
+        self, cycle: int, running_since: Dict[str, int]
+    ) -> List[dict]:
+        """Serving deployment arrivals (annotated per the api/serving.py
+        schema) and replica churn: one replica of a running serving job
+        is deleted and a fresh Pending replacement created — the
+        rolling-restart analog, re-measuring placement latency on the
+        replacement."""
+        import math
+
+        from ..api import (
+            REPLICA_FLOOR_ANNOTATION_KEY,
+            RESERVED_ONLY_ANNOTATION_KEY,
+            SLO_SECONDS_ANNOTATION_KEY,
+            TPU_GENERATIONS_ANNOTATION_KEY,
+            WORKLOAD_CLASS_ANNOTATION_KEY,
+            WORKLOAD_CLASS_SERVING,
+        )
+
+        spec, rng = self.spec, self.rng
+        events: List[dict] = []
+
+        # Replica churn on one running serving job.
+        if spec.serving_churn > 0 and rng.random() < spec.serving_churn:
+            candidates = sorted(
+                name for name, meta in self.alive.items()
+                if meta.get("serving")
+                and meta.get("replicas")
+                and name in running_since
+                and name not in self._pending_delete
+            )
+            if candidates:
+                job = candidates[rng.randrange(len(candidates))]
+                meta = self.alive[job]
+                victim = meta["replicas"].pop(0)
+                churned = meta.get("churned", 0)
+                meta["churned"] = churned + 1
+                replacement = f"{job}-c{churned}"
+                meta["replicas"].append(replacement)
+                events.append({
+                    "kind": "pod-delete",
+                    "pod": f"sim/{victim}",
+                })
+                events.append({
+                    "kind": "pod-recreate",
+                    "job": job,
+                    "names": [replacement],
+                })
+
+        arrivals = _poisson(rng, spec.serving_rate)
+        for _ in range(arrivals):
+            if len(self.alive) - len(self._pending_delete) >= (
+                spec.max_jobs_in_flight
+            ):
+                break
+            size = int(_weighted(rng, spec.serving_sizes)[0])
+            cpu_m, mem_mi, _ = _weighted(rng, spec.reqs)
+            duration = rng.randint(*spec.serving_duration)
+            floor = max(
+                1, math.ceil(size * max(0.0, spec.serving_floor_frac))
+            )
+            annotations = {
+                WORKLOAD_CLASS_ANNOTATION_KEY: WORKLOAD_CLASS_SERVING,
+                SLO_SECONDS_ANNOTATION_KEY: str(spec.serving_slo_s),
+                REPLICA_FLOOR_ANNOTATION_KEY: str(floor),
+            }
+            if rng.random() < spec.serving_reserved_frac:
+                annotations[RESERVED_ONLY_ANNOTATION_KEY] = "1"
+            if (
+                spec.node_generations
+                and rng.random() < spec.serving_gen_frac
+            ):
+                annotations[TPU_GENERATIONS_ANNOTATION_KEY] = (
+                    spec.node_generations[
+                        rng.randrange(len(spec.node_generations))
+                    ]
+                )
+            name = f"simserve-{self._job_seq:05d}"
+            self._job_seq += 1
+            self.alive[name] = {
+                "duration": duration,
+                "min_member": 1,
+                "serving": True,
+                "replicas": [f"{name}-{i}" for i in range(size)],
+                "churned": 0,
+            }
+            events.append({
+                "kind": "job-create",
+                "name": name,
+                "queue": spec.serving_queue,
+                "replicas": size,
+                "min_member": 1,
+                "cpu_m": int(cpu_m),
+                "mem_mi": int(mem_mi),
+                "duration": duration,
+                "annotations": dict(annotations),
+                "replica_floor": floor,
             })
         return events
 

@@ -77,6 +77,68 @@ def test_zero_new_compilations_across_steady_delta_cycles():
     c.shutdown()
 
 
+def test_zero_new_compilations_with_serving_rows_present():
+    """Serving twin (doc/design/serving.md): SLO-constrained jobs add
+    feasibility-mask group rows and per-task score rows to the pack.
+    With a fixed set of constraint signatures the group axis is as
+    shape-stable as every other axis — steady/delta cycles over a mixed
+    serving+batch snapshot on a labeled (heterogeneous) node pool must
+    stay trace-free after warmup."""
+    from kube_batch_tpu.api.serving import (
+        CAPACITY_TYPE_LABEL_KEY,
+        RESERVED_ONLY_ANNOTATION_KEY,
+        SLO_SECONDS_ANNOTATION_KEY,
+        TOPOLOGY_TIER_LABEL_KEY,
+        WORKLOAD_CLASS_ANNOTATION_KEY,
+    )
+    from kube_batch_tpu.api import PodPhase, build_resource_list
+    from kube_batch_tpu.utils.test_utils import build_node, build_pod
+
+    c = build_cluster(seed=53, groups=6, per_group=40, nodes=6)
+    # Heterogeneous extension of the pool: labeled spot + tiered nodes
+    # so the serving rows are genuinely non-trivial.
+    for j, labels in enumerate((
+        {CAPACITY_TYPE_LABEL_KEY: "spot"},
+        {TOPOLOGY_TIER_LABEL_KEY: "2"},
+    )):
+        c.add_node(build_node(
+            f"hn{j}",
+            build_resource_list(cpu="16", memory="64Gi", pods=110),
+            labels=labels,
+        ))
+    # One serving deployment (shared constraint signature) riding an
+    # existing pod group's queue: 8 replicas, reserved-only + SLO.
+    for i in range(8):
+        pod = build_pod(
+            "ns", f"serve-{i}", "", PodPhase.PENDING,
+            build_resource_list(cpu="250m", memory="256Mi"),
+            group_name="pg0",
+        )
+        pod.metadata.annotations.update({
+            WORKLOAD_CLASS_ANNOTATION_KEY: "serving",
+            SLO_SECONDS_ANNOTATION_KEY: "2.0",
+            RESERVED_ONLY_ANNOTATION_KEY: "1",
+        })
+        c.add_pod(pod)
+    tiers = make_tiers(
+        ["priority", "gang", "conformance"],
+        ["drf", "predicates", "proportion", "nodeorder", "serving"],
+    )
+    for _ in range(WARM_CYCLES):
+        one_cycle(c, tiers, churn=2)
+    warm = jit_compilation_count()
+    assert warm > 0
+    for cycle in range(GUARD_CYCLES):
+        one_cycle(c, tiers, churn=2)
+        now = jit_compilation_count()
+        assert now == warm, (
+            f"serving cycle {cycle} minted {now - warm} new jit "
+            "compilation(s) — the serving mask/score rows broke the "
+            "shape-stability contract"
+        )
+    c.shutdown()
+
+
 def test_zero_new_compilations_sharded_sparse_cycles(monkeypatch):
     """The sharded-sparse twin: steady/delta cycles through the
     task-sharded shard_map sparse solve (forced slabs + flat mode on

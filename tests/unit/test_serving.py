@@ -1,0 +1,444 @@
+"""Serving-subsystem units (doc/design/serving.md): the annotation/
+label schema parses totally (malformed values degrade, never raise),
+node-class feasibility verdicts, the combine-level bit-parity contract
+(an all-default BatchMask folds in as structurally nothing), the
+serving plugin's mask/score compilation and preempt/reclaim gate, and
+the ledger's per-class SLO accounting + violation budget."""
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.api.serving import (
+    CAPACITY_SPOT,
+    DEFAULT_NODE_CLASS,
+    MIN_TOPOLOGY_TIER_ANNOTATION_KEY,
+    REPLICA_FLOOR_ANNOTATION_KEY,
+    RESERVED_ONLY_ANNOTATION_KEY,
+    SLO_SECONDS_ANNOTATION_KEY,
+    TOPOLOGY_TIER_LABEL_KEY,
+    TPU_GENERATION_LABEL_KEY,
+    TPU_GENERATIONS_ANNOTATION_KEY,
+    CAPACITY_TYPE_LABEL_KEY,
+    WORKLOAD_CLASS_ANNOTATION_KEY,
+    NodeClass,
+    ServingSLO,
+    node_class_from_labels,
+    parse_serving_slo,
+    parse_workload_class,
+    slo_permits_node,
+)
+from kube_batch_tpu.obs.latency import PlacementLedger
+from kube_batch_tpu.plugins import serving as serving_mod
+from kube_batch_tpu.plugins.serving import (
+    MAX_PRIORITY,
+    PREEMPT_OVERRIDE_ENV,
+    ServingPlugin,
+    node_class_score,
+)
+from kube_batch_tpu.plugins.util import PredicateError
+from kube_batch_tpu.solver.masks import BatchMask, combine_masks
+
+SERVING_ANN = {WORKLOAD_CLASS_ANNOTATION_KEY: "serving"}
+
+
+# ---------------------------------------------------------------- parsing
+
+
+class TestParsing:
+    def test_workload_class_defaults_to_batch(self):
+        assert parse_workload_class({}) == "batch"
+        assert parse_workload_class(None) == "batch"
+        assert parse_workload_class(
+            {WORKLOAD_CLASS_ANNOTATION_KEY: "inference"}
+        ) == "batch"
+        assert parse_workload_class(SERVING_ANN) == "serving"
+
+    def test_batch_pod_has_no_slo(self):
+        assert parse_serving_slo({}) is None
+        assert parse_serving_slo(
+            {SLO_SECONDS_ANNOTATION_KEY: "2.0"}
+        ) is None  # SLO annotations without the class opt-in are inert
+
+    def test_full_slo_parses(self):
+        slo = parse_serving_slo({
+            **SERVING_ANN,
+            SLO_SECONDS_ANNOTATION_KEY: "1.5",
+            REPLICA_FLOOR_ANNOTATION_KEY: "3",
+            TPU_GENERATIONS_ANNOTATION_KEY: "v5e, v5p",
+            MIN_TOPOLOGY_TIER_ANNOTATION_KEY: "2",
+            RESERVED_ONLY_ANNOTATION_KEY: "1",
+        })
+        assert slo == ServingSLO(
+            target_seconds=1.5, replica_floor=3,
+            generations=frozenset({"v5e", "v5p"}),
+            min_topology_tier=2, reserved_only=True,
+        )
+        assert slo.constrains_nodes()
+
+    def test_malformed_values_degrade_not_raise(self):
+        slo = parse_serving_slo({
+            **SERVING_ANN,
+            SLO_SECONDS_ANNOTATION_KEY: "fast",
+            REPLICA_FLOOR_ANNOTATION_KEY: "-3",
+            TPU_GENERATIONS_ANNOTATION_KEY: " , ",
+            MIN_TOPOLOGY_TIER_ANNOTATION_KEY: "high",
+            RESERVED_ONLY_ANNOTATION_KEY: "yes",
+        })
+        assert slo == ServingSLO()
+        assert not slo.constrains_nodes()
+
+    def test_unlabeled_node_is_the_shared_default_class(self):
+        # Identity matters: clones share one object, and a batch-only
+        # cluster must not allocate a NodeClass per node.
+        assert node_class_from_labels({}) is DEFAULT_NODE_CLASS
+        assert node_class_from_labels(None) is DEFAULT_NODE_CLASS
+        assert node_class_from_labels(
+            {TOPOLOGY_TIER_LABEL_KEY: "junk"}
+        ) is DEFAULT_NODE_CLASS
+
+    def test_node_labels_parse(self):
+        nc = node_class_from_labels({
+            TPU_GENERATION_LABEL_KEY: "v5p",
+            TOPOLOGY_TIER_LABEL_KEY: "3",
+            CAPACITY_TYPE_LABEL_KEY: "spot",
+        })
+        assert nc == NodeClass(
+            generation="v5p", topology_tier=3, capacity=CAPACITY_SPOT
+        )
+        assert nc.spot
+
+
+# ------------------------------------------------------------ feasibility
+
+
+class TestFeasibility:
+    def test_unconstrained_permits_everything(self):
+        slo = ServingSLO(target_seconds=1.0)
+        assert slo_permits_node(slo, DEFAULT_NODE_CLASS)
+        assert slo_permits_node(slo, NodeClass(capacity=CAPACITY_SPOT))
+
+    def test_generation_whitelist(self):
+        slo = ServingSLO(generations=frozenset({"v5p"}))
+        assert slo_permits_node(slo, NodeClass(generation="v5p"))
+        assert not slo_permits_node(slo, NodeClass(generation="v5e"))
+        assert not slo_permits_node(slo, DEFAULT_NODE_CLASS)  # unlabeled
+
+    def test_min_topology_tier(self):
+        slo = ServingSLO(min_topology_tier=2)
+        assert not slo_permits_node(slo, NodeClass(topology_tier=1))
+        assert slo_permits_node(slo, NodeClass(topology_tier=2))
+
+    def test_reserved_only_excludes_spot(self):
+        slo = ServingSLO(reserved_only=True)
+        assert slo_permits_node(slo, DEFAULT_NODE_CLASS)
+        assert not slo_permits_node(
+            slo, NodeClass(capacity=CAPACITY_SPOT)
+        )
+
+    def test_node_class_score_shape(self):
+        assert node_class_score(NodeClass(capacity=CAPACITY_SPOT)) == 0.0
+        assert node_class_score(DEFAULT_NODE_CLASS) == MAX_PRIORITY / 2
+        assert node_class_score(
+            NodeClass(topology_tier=4)
+        ) == MAX_PRIORITY
+        # Tier preference saturates instead of growing unboundedly.
+        assert node_class_score(
+            NodeClass(topology_tier=9)
+        ) == MAX_PRIORITY
+        spot_hi = node_class_score(
+            NodeClass(capacity=CAPACITY_SPOT, topology_tier=4)
+        )
+        assert spot_hi == MAX_PRIORITY / 2  # spot never beats reserved
+
+
+# -------------------------------------------------- combine-level parity
+
+
+class TestMaskParity:
+    def test_default_batchmask_is_structurally_absent(self):
+        T, N = 7, 5
+        with_plugin = combine_masks([BatchMask()], T, N)
+        without = combine_masks([], T, N)
+        for attr in (
+            "node_ok", "task_group", "group_rows", "pair_idx", "pair_rows"
+        ):
+            a = getattr(with_plugin, attr)
+            b = getattr(without, attr)
+            assert a.dtype == b.dtype
+            assert np.array_equal(a, b), attr
+
+    def test_group_rows_fold_matches_dense(self):
+        T, N = 4, 6
+        rng = np.random.RandomState(3)
+        rows = np.vstack([
+            np.ones(N, dtype=bool), rng.rand(N) > 0.4, rng.rand(N) > 0.4,
+        ])
+        mask = BatchMask(
+            task_group=np.array([0, 1, 2, 1], dtype=np.int32),
+            group_rows=rows,
+        )
+        combined = combine_masks([mask], T, N)
+        dense = mask.dense(T, N)
+        for i in range(T):
+            assert np.array_equal(combined.row(i), dense[i])
+
+
+# ------------------------------------------------- plugin compilation
+
+class StubTask:
+    def __init__(self, job):
+        self.job = job
+
+
+class StubNode:
+    def __init__(self, name, node_class):
+        self.name = name
+        self.node_class = node_class
+
+
+class StubJob:
+    def __init__(self, slo=None, ready=0):
+        self.slo = slo
+        self._ready = ready
+
+    def ready_task_num(self):
+        return self._ready
+
+
+class StubSession:
+    """Records the callbacks ServingPlugin registers."""
+
+    def __init__(self, jobs):
+        self.jobs = jobs
+        self.fns = {}
+
+    def add_predicate_fn(self, name, fn):
+        self.fns["predicate"] = fn
+
+    def add_batch_predicate_fn(self, name, fn):
+        self.fns["batch_predicate"] = fn
+
+    def add_node_order_fn(self, name, fn, weight=1.0):
+        self.fns["node_order"] = fn
+
+    def add_batch_node_order_fn(self, name, fn, weight=1.0):
+        self.fns["batch_node_order"] = fn
+
+    def add_preemptable_fn(self, name, fn):
+        self.fns["preemptable"] = fn
+
+    def add_reclaimable_fn(self, name, fn):
+        self.fns["reclaimable"] = fn
+
+
+def open_stub_session(jobs):
+    ssn = StubSession(jobs)
+    ServingPlugin().on_session_open(ssn)
+    return ssn
+
+
+RESERVED_SLO = ServingSLO(target_seconds=1.0, reserved_only=True)
+
+
+def mixed_fixture():
+    """2 batch tasks + 3 serving (two sharing one spec) over 4 nodes,
+    one of them spot."""
+    jobs = {
+        "b": StubJob(),
+        "s1": StubJob(slo=RESERVED_SLO),
+        "s2": StubJob(slo=RESERVED_SLO),
+        "s3": StubJob(slo=ServingSLO(generations=frozenset({"v5p"}))),
+    }
+    tasks = [
+        StubTask("b"), StubTask("s1"), StubTask("b"),
+        StubTask("s2"), StubTask("s3"),
+    ]
+    nodes = [
+        StubNode("n0", DEFAULT_NODE_CLASS),
+        StubNode("n1", NodeClass(capacity=CAPACITY_SPOT)),
+        StubNode("n2", NodeClass(generation="v5p")),
+        StubNode("n3", NodeClass(generation="v5p",
+                                 capacity=CAPACITY_SPOT)),
+    ]
+    return jobs, tasks, nodes
+
+
+class TestPluginCompilation:
+    def test_batch_only_snapshot_compiles_to_default_mask(self):
+        ssn = open_stub_session({"b": StubJob()})
+        tasks = [StubTask("b"), StubTask("b")]
+        nodes = [StubNode("n0", DEFAULT_NODE_CLASS)]
+        mask = ssn.fns["batch_predicate"](tasks, nodes)
+        assert isinstance(mask, BatchMask)
+        assert mask.node_ok is None
+        assert mask.task_group is None
+        assert mask.group_rows is None
+        assert mask.rows == {}
+        # ...and the scorer contributes no rows either.
+        assert ssn.fns["batch_node_order"](tasks, nodes) == {}
+
+    def test_signature_sharing_and_verdicts(self):
+        jobs, tasks, nodes = mixed_fixture()
+        ssn = open_stub_session(jobs)
+        mask = ssn.fns["batch_predicate"](tasks, nodes)
+        # Group 0 is the unconstrained row; s1/s2 share one signature
+        # row, s3 gets its own: 3 rows total, not 1-per-task.
+        assert mask.group_rows.shape == (3, len(nodes))
+        tg = mask.task_group
+        assert tg[0] == tg[2] == 0            # batch tasks unconstrained
+        assert tg[1] == tg[3]                 # shared spec -> shared row
+        assert tg[4] not in (0, tg[1])
+        dense = mask.dense(len(tasks), len(nodes))
+        for i, task in enumerate(tasks):
+            slo = jobs[task.job].slo
+            for j, node in enumerate(nodes):
+                want = slo is None or slo_permits_node(
+                    slo, node.node_class
+                )
+                assert dense[i, j] == want, (i, j)
+
+    def test_score_rows_only_for_serving_tasks(self):
+        jobs, tasks, nodes = mixed_fixture()
+        ssn = open_stub_session(jobs)
+        rows = ssn.fns["batch_node_order"](tasks, nodes)
+        assert sorted(rows) == [1, 3, 4]
+        # One shared per-node row (the score depends only on the node).
+        assert rows[1] is rows[3] is rows[4]
+        expect = [node_class_score(n.node_class) for n in nodes]
+        assert rows[1].dtype == np.float32
+        assert np.allclose(rows[1], expect)
+
+    def test_scalar_predicate_mirrors_the_mask(self):
+        jobs, tasks, nodes = mixed_fixture()
+        ssn = open_stub_session(jobs)
+        pred = ssn.fns["predicate"]
+        pred(tasks[0], nodes[1])          # batch task: anything goes
+        pred(tasks[1], nodes[0])          # reserved node ok
+        with pytest.raises(PredicateError):
+            pred(tasks[1], nodes[1])      # spot violates reserved_only
+        with pytest.raises(PredicateError):
+            pred(tasks[4], nodes[0])      # unlabeled violates gen pin
+
+
+# ------------------------------------------------------- eviction gate
+
+
+class BudgetStub:
+    def __init__(self, bad_jobs=()):
+        self.bad = set(bad_jobs)
+
+    def serving_budget_ok(self, job):
+        return job not in self.bad
+
+
+class TestEvictionGate:
+    def gate(self, jobs, monkeypatch, bad_jobs=()):
+        monkeypatch.setattr(
+            serving_mod, "LEDGER", BudgetStub(bad_jobs)
+        )
+        ssn = open_stub_session(jobs)
+        assert ssn.fns["preemptable"] is ssn.fns["reclaimable"]
+        return ssn.fns["preemptable"]
+
+    def test_batch_victims_pass_through(self, monkeypatch):
+        gate = self.gate({"b": StubJob()}, monkeypatch)
+        victims = [StubTask("b"), StubTask("b")]
+        assert gate(StubTask("x"), victims) == victims
+
+    def test_replica_floor_blocks_eviction(self, monkeypatch):
+        slo = ServingSLO(replica_floor=2)
+        jobs = {
+            "at-floor": StubJob(slo=slo, ready=2),
+            "above": StubJob(slo=slo, ready=3),
+        }
+        gate = self.gate(jobs, monkeypatch)
+        at_floor, above = StubTask("at-floor"), StubTask("above")
+        out = gate(StubTask("x"), [at_floor, above])
+        assert out == [above]  # taking "at-floor" below 2 is barred
+
+    def test_budget_burn_blocks_eviction(self, monkeypatch):
+        jobs = {"s": StubJob(slo=ServingSLO(target_seconds=1.0), ready=9)}
+        gate = self.gate(jobs, monkeypatch, bad_jobs={"s"})
+        assert gate(StubTask("x"), [StubTask("s")]) == []
+
+    def test_override_disables_the_gate(self, monkeypatch):
+        monkeypatch.setenv(PREEMPT_OVERRIDE_ENV, "1")
+        jobs = {"s": StubJob(slo=ServingSLO(replica_floor=5), ready=5)}
+        gate = self.gate(jobs, monkeypatch, bad_jobs={"s"})
+        victims = [StubTask("s")]
+        assert gate(StubTask("x"), victims) == victims
+
+
+# ------------------------------------------------------ ledger accounting
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def place(ledger, clock, uid, job, wait, queue="serving"):
+    ledger.note_placed([(uid, job)], {job: queue})
+    ledger.note_dispatched([uid])
+    clock.t += wait
+    ledger.note_applied(uid)
+
+
+class TestLedgerAccounting:
+    def make(self):
+        ledger = PlacementLedger()
+        clock = FakeClock()
+        ledger.configure(enabled=True, clock=clock)
+        return ledger, clock
+
+    def test_met_missed_and_attainment(self):
+        ledger, clock = self.make()
+        ledger.note_arrival(
+            "u1", "ns/s-0", "ns/s", workload_class="serving",
+            slo_target=1.0,
+        )
+        ledger.note_arrival(
+            "u2", "ns/s-1", "ns/s", workload_class="serving",
+            slo_target=1.0,
+        )
+        place(ledger, clock, "u1", "ns/s", wait=0.5)   # met
+        place(ledger, clock, "u2", "ns/s", wait=2.0)   # missed
+        s = ledger.serving_summary()
+        cls = s["classes"]["serving"]
+        assert cls["placed"] == 2
+        assert cls["met"] == 1
+        assert cls["missed"] == 1
+        assert cls["attainment_pct"] == 50.0
+        assert s["violations"] == 1
+        assert s["budget_burn"] > 1.0     # 1 miss vs 0.02 allowed
+        # ...and the burning job may no longer donate capacity.
+        assert not ledger.serving_budget_ok("ns/s")
+        assert ledger.serving_budget_ok("ns/other")  # untargeted passes
+
+    def test_pressure_and_arrival_pending(self):
+        ledger, clock = self.make()
+        assert not ledger.serving_pressure()
+        ledger.note_arrival(
+            "u1", "ns/s-0", "ns/s", workload_class="serving",
+            slo_target=1.0,
+        )
+        # Arrival-pending is a consume-once micro-cycle wakeup signal.
+        assert ledger.serving_arrival_pending()
+        assert not ledger.serving_arrival_pending()
+        assert not ledger.serving_pressure()  # deadline not yet passed
+        clock.t += 1.5
+        assert ledger.serving_pressure()
+        place(ledger, clock, "u1", "ns/s", wait=0.0)
+        assert not ledger.serving_pressure()
+
+    def test_batch_arrivals_never_engage_serving_accounting(self):
+        ledger, clock = self.make()
+        ledger.note_arrival("u1", "ns/b-0", "ns/b")
+        place(ledger, clock, "u1", "ns/b", wait=5.0, queue="batch")
+        s = ledger.serving_summary()
+        assert s["classes"] == {}
+        assert s["violations"] == 0
+        assert "serving_slo_miss_rate" not in ledger.telemetry_sample()
