@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke latency-smoke diverge-smoke congest-smoke serving-smoke bench-compare verify kbtlint typecheck ci image clean
+.PHONY: all native test e2e perf perf-quick bench bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke latency-smoke diverge-smoke congest-smoke serving-smoke quality-smoke bench-compare verify kbtlint typecheck ci image clean
 
 all: native
 
@@ -240,6 +240,40 @@ serving-smoke:
 latency-smoke:
 	env $(CPU_ENV) $(PY) tools/latency_smoke.py
 
+# Placement-quality scorecard smoke (obs/quality.py,
+# doc/design/quality.md): (1) record a churny run dumping the
+# per-cycle scorecard stream and assert the scorecard actually engaged
+# (one card per cycle, placements scored); (2) replay it — the
+# in-trace card comparison exits 2 on divergence and the dumped JSONL
+# must be byte-identical (same contract as the audit log); (3) run the
+# 2-seed paired flat-vs-two-level mini-study TWICE and pin the
+# paired-stats determinism (same seeds → byte-identical study JSON).
+quality-smoke:
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim \
+		--cycles 24 --seed 7 --backend native \
+		--node-churn 0.05 --faults "evict:0.05" \
+		--trace /tmp/kbt_quality_smoke.jsonl \
+		--quality-out /tmp/kbt_quality_smoke.quality.jsonl \
+		--fail-on-cycle-errors --quiet
+	$(PY) -c "import json; cards = [json.loads(l) for l in \
+		open('/tmp/kbt_quality_smoke.quality.jsonl')]; \
+		assert len(cards) == 24, len(cards); \
+		assert any(c['churn']['placements'] > 0 for c in cards), \
+		'scorecard never scored a placement'"
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim \
+		--replay /tmp/kbt_quality_smoke.jsonl --backend native \
+		--quality-out /tmp/kbt_quality_smoke.quality.replay.jsonl \
+		--fail-on-cycle-errors --quiet
+	cmp /tmp/kbt_quality_smoke.quality.jsonl \
+		/tmp/kbt_quality_smoke.quality.replay.jsonl
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim-study \
+		--preset twolevel --seeds 2 --cycles 10 --nodes 8 \
+		--workers 4 --out /tmp/kbt_quality_study_a.json --quiet
+	env $(CPU_ENV) $(PY) -m kube_batch_tpu sim-study \
+		--preset twolevel --seeds 2 --cycles 10 --nodes 8 \
+		--workers 4 --out /tmp/kbt_quality_study_b.json --quiet
+	cmp /tmp/kbt_quality_study_a.json /tmp/kbt_quality_study_b.json
+
 # Bench regression sentinel across the two newest committed bench
 # rounds (noise-aware: canary-normalized thresholds + the explicit
 # allowlist), THEN its own self-test: an injected 20% cycle_ms
@@ -296,7 +330,7 @@ typecheck:
 # The smoke run writes its OWN artifact: `make ci` after `make perf`
 # must not clobber the committed design-scale perf-artifact.json with a
 # 300-pod smoke (that is exactly how the r3 artifact ended up 300/20).
-ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke diverge-smoke latency-smoke congest-smoke serving-smoke bench-compare
+ci: verify kbtlint typecheck native test bench-smoke sim-smoke soak-smoke chaos-smoke micro-smoke shard-smoke failover-smoke diverge-smoke latency-smoke congest-smoke serving-smoke quality-smoke bench-compare
 	env $(CPU_ENV) $(PY) -m kube_batch_tpu.perf --pods 300 --nodes 20 \
 		--group-size 10 --out perf-smoke.json
 	env $(CPU_ENV) _KBT_BENCH_CPU=1 $(PY) bench.py --config small

@@ -594,6 +594,11 @@ def bench_cycle(cfg, seed=0, cache=None, trace_path=None,
         TRACER.disable()
     if measure_obs:
         out["obs"] = bench_obs(one_cycle, cache=cache)
+        # Quality scorecard cost against the same (still-live) benched
+        # cache; amortized against the measured warm steady cycle.
+        out["quality"] = bench_quality(
+            cache, steady_ms=steady_warm.get("cycle_ms")
+        )
     cache.shutdown()
     return out
 
@@ -744,6 +749,50 @@ def bench_obs(one_cycle, runs=7, cache=None):
         ),
         "runs": runs,
     }
+
+
+def bench_quality(cache, steady_ms=None, repeats=5):
+    """Placement-quality scorecard cost at the benched shape
+    (obs/quality.py): a full ``compute_scorecard`` against the REAL
+    benched cache (50k tasks x 5k nodes on the large config), median
+    of ``repeats`` with the memo state warm, plus the amortized
+    production overhead — per-card cost divided by the
+    KBT_QUALITY_EVERY cadence, as a percentage of the measured warm
+    steady cycle (the <1% budget the design doc quotes). The benched
+    snapshot's headline density/fairness numbers ride along, so the
+    committed rounds carry a packing-quality trend next to the latency
+    trend."""
+    from kube_batch_tpu.obs.quality import (
+        DEFAULT_QUALITY_EVERY,
+        compute_scorecard,
+    )
+
+    state = {}
+    card = compute_scorecard(cache, state=state)  # cold: builds memos
+    times = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        card = compute_scorecard(cache, state=state)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    card_ms = times[len(times) // 2] * 1e3
+    every = DEFAULT_QUALITY_EVERY
+    out = {
+        "card_ms": round(card_ms, 3),
+        "every": every,
+        "amortized_ms": round(card_ms / every, 4),
+        "nodes": card["nodes"],
+        "queues": card["queues"],
+        "density_dom": card["density_dom"],
+        "density": card["density"],
+        "fairness_jain": card["fairness"]["jain"],
+        "emptiable_frac": card["frag"]["emptiable_frac"],
+    }
+    if steady_ms:
+        out["overhead_pct_of_steady"] = round(
+            100.0 * (card_ms / every) / steady_ms, 3
+        )
+    return out
 
 
 def bench_arrival_latency(quick=False, seed=23):
@@ -1992,6 +2041,9 @@ def main():
     except Exception as exc:  # pragma: no cover - defensive
         cycle = {"error": f"{type(exc).__name__}: {exc}"}
     obs = cycle.pop("obs", None) if isinstance(cycle, dict) else None
+    quality = (
+        cycle.pop("quality", None) if isinstance(cycle, dict) else None
+    )
 
     # Device-resident snapshot pack stats (small config: the mechanics,
     # not the scale — the headline cycles carry device_* keys whenever
@@ -2125,6 +2177,7 @@ def main():
         "device_provenance": provenance,
         "cycle": cycle,
         "obs": obs,
+        "quality": quality,
         "device_cache": device_cache,
         "solver_sparse": tpu["sparse"],
         "sim": sim,

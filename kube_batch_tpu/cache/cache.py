@@ -1664,8 +1664,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 # per-task dispatch paths call bind() in a loop); the
                 # append still strictly precedes the bind in this job.
                 from ..obs.latency import LEDGER
+                from ..obs.quality import QUALITY
 
                 LEDGER.note_dispatched((task_snapshot.uid,))
+                QUALITY.note_bound((task_snapshot.uid,))
                 seq = self._journal_append([task_snapshot])
                 self._bind_side_effect(
                     pod, hostname, task_snapshot, journal_seq=seq
@@ -1851,8 +1853,10 @@ class SchedulerCache(Cache, EventHandlersMixin):
         # are DISPATCHED; validation failures / node rejections restart
         # their pods' clocks exactly like an async bind failure.
         from ..obs.latency import LEDGER
+        from ..obs.quality import QUALITY
 
         LEDGER.note_dispatched([t.uid for t in bound])
+        QUALITY.note_bound([t.uid for t in bound])
         for uid in failed_marks:
             LEDGER.note_bind_failed(uid, reason="bind-rejected")
 
@@ -1938,11 +1942,15 @@ class SchedulerCache(Cache, EventHandlersMixin):
                 )
         # Preempt/reclaim eviction restarts the victim's placement
         # clock (requeued stage) — outside the mutex, leaf-lock ledger.
+        # The quality monitor counts the same event as disruption churn
+        # (and remembers the uid so its next bind counts as a RE-bind).
         from ..obs.latency import LEDGER
+        from ..obs.quality import QUALITY
 
         LEDGER.note_requeued(
             task_info.uid, reason="evicted", job=task_info.job
         )
+        QUALITY.note_eviction(task_info.uid, reason)
 
         def _do_evict():
             if self._refused_by_fence(

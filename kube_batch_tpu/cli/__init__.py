@@ -58,6 +58,12 @@ def main(argv=None) -> None:
         from ..sim.cli import main as sim_main
 
         sys.exit(sim_main(args[1:]))
+    if args and args[0] == "sim-study":
+        # Subcommand: multi-seed paired A/B placement-quality study
+        # (kube_batch_tpu/sim/study.py). `sim-study --help`.
+        from ..sim.study import main as study_main
+
+        sys.exit(study_main(args[1:]))
     if args and args[0] == "explain":
         # Subcommand: pending-gang explainability
         # (`python -m kube_batch_tpu explain <ns>/<job>` — obs/explain).

@@ -21,7 +21,7 @@ from typing import Optional, Tuple
 from .. import metrics
 from ..cache import new_scheduler_cache
 from ..cluster import ClusterAPI, InProcessCluster
-from ..obs import RECORDER, TELEMETRY
+from ..obs import QUALITY, RECORDER, TELEMETRY
 from ..obs import explain as obs_explain
 from ..obs import latency as obs_latency
 from ..obs import telemetry as obs_telemetry
@@ -57,6 +57,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     - ``/debug/latency``: the placement-latency ledger snapshot —
       per-queue/per-cycle-kind stage-decomposed percentiles, recent
       applied entries, audit-ring meta (obs/latency.py);
+    - ``/debug/quality``: the placement-quality monitor snapshot —
+      the newest scorecard (density/fragmentation/fairness/churn)
+      plus the cumulative churn counters (obs/quality.py);
     - ``/debug/jobs`` and ``/debug/jobs/<ns>/<name>``: per-job last
       unschedulable verdicts (obs/explain.py).
 
@@ -153,6 +156,36 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             }
         except Exception:  # pragma: no cover - probes must not 500
             logger.exception("/debug/vars robustness probe failed")
+        # Placement-quality surface (doc/design/quality.md): headline
+        # numbers off the newest scorecard (packing density, Jain
+        # fairness, emptiable nodes, churn per placement) plus the
+        # cumulative disruption counters — one curl answers "is the
+        # scheduler placing WELL, not just fast". The full card lives
+        # at /debug/quality.
+        try:
+            snap = QUALITY.snapshot()
+            last = snap.get("last") or {}
+            out["quality"] = {
+                "enabled": snap["enabled"],
+                "every": snap["every"],
+                "cards_computed": snap["cards_computed"],
+                "counters": snap["counters"],
+                "density_dom": last.get("density_dom"),
+                "fairness_jain": (
+                    last.get("fairness", {}).get("jain")
+                    if last else None
+                ),
+                "emptiable_nodes": (
+                    last.get("frag", {}).get("emptiable_nodes")
+                    if last else None
+                ),
+                "churn_per_placement": (
+                    last.get("churn", {}).get("per_placement")
+                    if last else None
+                ),
+            }
+        except Exception:  # pragma: no cover - probes must not 500
+            logger.exception("/debug/vars quality probe failed")
         # State-integrity surface (doc/design/robustness.md, cluster-
         # truth anti-entropy): absorbed event-stream anomalies, watch-
         # gap/relist state, and the divergence sweep's cumulative
@@ -197,6 +230,13 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             payload["audit"] = obs_latency.AUDIT.meta()
             self._reply(
                 json.dumps(payload, sort_keys=True, default=repr) + "\n",
+                ctype="application/json",
+            )
+        elif path == "/debug/quality":
+            self._reply(
+                json.dumps(
+                    QUALITY.snapshot(), sort_keys=True, default=repr
+                ) + "\n",
                 ctype="application/json",
             )
         elif path == "/debug/jobs":

@@ -687,6 +687,64 @@ serving_slo_budget_burn = REGISTRY.register(
         "attainment budget is blown)",
     )
 )
+# Placement-quality scorecard (kube_batch_tpu/obs/quality.py,
+# doc/design/quality.md): the Prometheus face of the per-card quality
+# signals. Gauges updated once per KBT_QUALITY_EVERY cycles; the churn
+# counters tick at the cache's evict/bind seams.
+quality_packing_density = REGISTRY.register(
+    Gauge(
+        "quality_packing_density",
+        "Cluster-aggregate used/allocatable per resource dimension "
+        "(the packing-density headline of the quality scorecard)",
+    ),
+    ("resource",),
+)
+quality_fairness_jain = REGISTRY.register(
+    Gauge(
+        "quality_fairness_jain",
+        "Jain fairness index over per-queue satisfaction ratios "
+        "(allocated vs water-filled deserved; 1.0 = perfectly "
+        "proportional)",
+    )
+)
+quality_emptiable_nodes = REGISTRY.register(
+    Gauge(
+        "quality_emptiable_nodes",
+        "Nodes that are empty or could be drained into the remaining "
+        "idle headroom (fragmentation/consolidation watermark)",
+    )
+)
+quality_largest_placeable_gang = REGISTRY.register(
+    Gauge(
+        "quality_largest_placeable_gang",
+        "Per queue: members of its largest pending gang the current "
+        "idle vectors could hold (series GC'd when the queue has no "
+        "pending gang)",
+    ),
+    ("queue",),
+)
+quality_churn_per_placement = REGISTRY.register(
+    Gauge(
+        "quality_churn_per_placement",
+        "Disruption churn: (evictions + re-binds) per placement over "
+        "the last scorecard interval",
+    )
+)
+quality_evictions = REGISTRY.register(
+    Counter(
+        "quality_evictions_total",
+        "Task evictions observed by the quality monitor, by reason "
+        "(preempt/reclaim/node-death/...)",
+    ),
+    ("reason",),
+)
+quality_rebinds = REGISTRY.register(
+    Counter(
+        "quality_rebinds_total",
+        "Re-binds: binds of tasks previously evicted (the disruption "
+        "half of preemption churn actually paid back)",
+    )
+)
 
 
 # Update helpers (reference metrics.go:122-170).
@@ -974,6 +1032,40 @@ def register_failover_recovery(outcome: str, count: int = 1) -> None:
     successor recovery pass (cache/recovery.py)."""
     if count:
         scheduler_failover_recoveries.inc((outcome,), amount=float(count))
+
+
+def register_quality_eviction(reason: str) -> None:
+    """One eviction seen by the quality monitor (obs/quality.py)."""
+    quality_evictions.inc((reason,))
+
+
+def register_quality_rebinds(n: int) -> None:
+    """``n`` binds of previously-evicted tasks (obs/quality.py)."""
+    if n:
+        quality_rebinds.inc(amount=float(n))
+
+
+def update_quality(card: dict) -> None:
+    """Push one quality scorecard to the gauges (obs/quality.py feeds
+    this every KBT_QUALITY_EVERY cycles)."""
+    for dim, v in card.get("density", {}).items():
+        quality_packing_density.set(float(v), (dim,))
+    fairness = card.get("fairness", {})
+    quality_fairness_jain.set(float(fairness.get("jain", 1.0)))
+    frag = card.get("frag", {})
+    quality_emptiable_nodes.set(float(frag.get("emptiable_nodes", 0)))
+    quality_churn_per_placement.set(
+        float(card.get("churn", {}).get("per_placement", 0.0))
+    )
+    # Every card reports every queue with a pending gang at once, so a
+    # gauge series outside the incoming set is stale — drop it (same
+    # label-GC contract as queue_fairness_drift).
+    gangs = frag.get("largest_gang", {})
+    for labels in quality_largest_placeable_gang.label_sets():
+        if labels and labels[0] not in gangs:
+            quality_largest_placeable_gang.remove(labels)
+    for queue, v in gangs.items():
+        quality_largest_placeable_gang.set(float(v), (queue,))
 
 
 def register_sim_cycle() -> None:

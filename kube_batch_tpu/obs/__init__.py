@@ -20,10 +20,16 @@ observability.md):
   flight record plus resource-watermark probes; served by
   ``/debug/timeseries``, embedded in flight dumps, and consumed by the
   simulator's soak-mode leak/drift detectors (``sim/soak.py``).
+- ``quality``: the placement-quality scorecard (packing density,
+  fragmentation, fairness distance, disruption churn, solver quality
+  rates) computed per cycle from the live cache, served by
+  ``/debug/quality``, attached to flight records, and driving the
+  ``quality:*`` telemetry series (doc/design/quality.md).
 """
 
 from .flightrecorder import RECORDER, FlightRecorder, install_sigusr1
 from .latency import AUDIT, LEDGER, AuditLog, PlacementLedger
+from .quality import QUALITY, QualityMonitor
 from .telemetry import TELEMETRY, QuantileSketch, Telemetry
 from .tracer import TRACER, Tracer, export_trace, span, trace_dir_from_env
 
@@ -33,6 +39,8 @@ __all__ = [
     "LEDGER",
     "FlightRecorder",
     "PlacementLedger",
+    "QUALITY",
+    "QualityMonitor",
     "QuantileSketch",
     "RECORDER",
     "TELEMETRY",

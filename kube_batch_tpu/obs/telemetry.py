@@ -368,6 +368,18 @@ class Telemetry:
                 v = solver.get(key)
                 if v is not None:
                     values[f"solver:{key}"] = float(v)
+            # Placement-quality card (obs/quality.py, attached before
+            # end_cycle on the KBT_QUALITY_EVERY cadence) → quality:*
+            # series; cycles without a card simply lack the keys
+            # (rollup windows tolerate sparse series).
+            quality = rec.get("quality")
+            if quality:
+                try:
+                    from .quality import telemetry_values
+
+                    values.update(telemetry_values(quality))
+                except Exception:  # pragma: no cover - probes only
+                    logger.exception("quality telemetry flatten failed")
         if cache is not None:
             self._cache_ref = weakref.ref(cache)
         values.update(collect_watermarks(

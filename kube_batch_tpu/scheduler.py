@@ -725,6 +725,17 @@ class Scheduler:
         e2e = time.perf_counter() - cycle_start
         metrics.update_e2e_duration(e2e)
         RECORDER.phase("done")
+        # Quality scorecard BEFORE end_cycle: the card rides in this
+        # cycle's still-open flight record (micro cycles count toward
+        # the KBT_QUALITY_EVERY cadence exactly like the telemetry
+        # probes — under micro-primary steady state the card must not
+        # go stale). Guarded: a probe failure never fails a cycle.
+        try:
+            from .obs.quality import QUALITY
+
+            QUALITY.annotate_cycle(self.cache)
+        except Exception:
+            logger.exception("quality cycle feed failed")
         rec = RECORDER.end_cycle(ok=ok, e2e_ms=round(e2e * 1e3, 3))
         self.micro_cycles_run += 1
         if self._telemetry:
@@ -844,6 +855,13 @@ class Scheduler:
         e2e = time.perf_counter() - cycle_start
         metrics.update_e2e_duration(e2e)
         RECORDER.phase("done")
+        # Quality scorecard BEFORE end_cycle (see run_micro).
+        try:
+            from .obs.quality import QUALITY
+
+            QUALITY.annotate_cycle(self.cache)
+        except Exception:
+            logger.exception("quality cycle feed failed")
         rec = RECORDER.end_cycle(e2e_ms=round(e2e * 1e3, 3))
         # Long-horizon telemetry: fold this cycle's record + resource
         # watermarks into the time-series (obs/telemetry.py). Guarded —

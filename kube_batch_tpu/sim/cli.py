@@ -1,7 +1,8 @@
 """``python -m kube_batch_tpu sim`` — the simulator entry point.
 
 Exit codes: 0 clean; 1 invariant violations (always — a sim run that
-breaks the contract must fail CI); 2 replay placement mismatch;
+breaks the contract must fail CI); 2 replay mismatch (placements, a
+failover block, or a placement-quality scorecard);
 3 scheduler-cycle errors with ``--fail-on-cycle-errors``; 4 soak-mode
 leak/drift detector trip (``--soak``); 5 the sharded-sparse engagement
 assert failed (``--require-sparse-sharded`` — the run never solved
@@ -150,6 +151,11 @@ def add_sim_flags(parser: argparse.ArgumentParser) -> None:
              "JSONL, virtual-clock-stamped — byte-identical under "
              "--replay) here; default: <trace>.audit.jsonl when "
              "--trace is set")
+    parser.add_argument(
+        "--quality-out", default=None, metavar="PATH",
+        help="write the per-cycle placement-quality scorecard stream "
+             "(canonical JSONL, obs/quality.py) to PATH — "
+             "byte-identical under a same-config --replay")
     parser.add_argument("--no-check", dest="check", action="store_false",
                         default=True, help="skip the invariant checker")
     parser.add_argument("--fail-on-cycle-errors", action="store_true",
@@ -302,6 +308,7 @@ def config_from_args(ns: argparse.Namespace) -> SimConfig:
         soak=ns.soak,
         telemetry_out=ns.telemetry_out,
         audit_out=ns.audit_out,
+        quality_out=ns.quality_out,
     )
 
 
@@ -378,6 +385,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             f"sim: replay diverged at cycles "
             f"{report.replay_mismatches[:10]}",
+            file=sys.stderr,
+        )
+        return 2
+    if report.quality_mismatches:
+        print(
+            f"sim: quality scorecard diverged under replay at cycles "
+            f"{report.quality_mismatches[:10]}",
             file=sys.stderr,
         )
         return 2

@@ -32,6 +32,12 @@ from ..api import PodPhase, build_resource_list
 from ..cache import SchedulerCache
 from ..cluster import InProcessCluster
 from ..obs import RECORDER
+from ..obs.quality import (
+    QUALITY,
+    compute_scorecard,
+    replay_view,
+    telemetry_values,
+)
 from ..obs.tracer import TRACER
 from ..scheduler import Scheduler
 from ..utils.test_utils import build_node, build_pod, build_pod_group, build_queue
@@ -39,7 +45,7 @@ from .clock import VirtualClock
 from .failover import CUT_POINTS, SimClusterEndpoint
 from .faults import FaultInjector, parse_fault_spec
 from .invariants import InvariantChecker
-from .trace import TRACE_VERSION, TraceReader, TraceWriter
+from .trace import TRACE_VERSION, TraceReader, TraceWriter, canon
 from .workload import WorkloadGenerator, WorkloadSpec
 
 logger = logging.getLogger(__name__)
@@ -117,6 +123,11 @@ class SimConfig:
     # pins this). Defaults to <trace>.audit.jsonl when a trace is
     # recorded.
     audit_out: Optional[str] = None
+    # Per-cycle placement-quality scorecard stream (--quality-out):
+    # canonical JSONL, one card per cycle — byte-identical under a
+    # same-config --replay (the in-trace comparison additionally
+    # strips the path-dependent solver deltas; obs/quality.py).
+    quality_out: Optional[str] = None
     # Anti-entropy sweep cadence override for the run (None = the
     # process default, KBT_ANTIENTROPY_EVERY): event-fault storms run
     # at 1 so every cycle's divergence is swept before its invariant
@@ -166,6 +177,11 @@ class SimReport:
     # end-of-run cleanliness verdict (unrepaired_end must be 0 for the
     # DIVERGE acceptance artifact; --require-divergence-repaired).
     integrity: Optional[dict] = None
+    # Placement-quality scorecard: replay-compared card mismatches
+    # (exit 2, same class as placement divergence) and the end-of-run
+    # summary the A/B study driver (sim/study.py) pairs across seeds.
+    quality_mismatches: List[int] = field(default_factory=list)
+    quality: Optional[dict] = None
 
     @property
     def cycles_per_sec(self) -> float:
@@ -204,6 +220,10 @@ class SimReport:
             } if self.latency is not None else {}),
             **({"integrity": self.integrity}
                if self.integrity is not None else {}),
+            **({"quality": self.quality}
+               if self.quality is not None else {}),
+            **({"quality_mismatches": list(self.quality_mismatches)}
+               if self.quality_mismatches else {}),
         }
 
 
@@ -303,6 +323,12 @@ class ClusterSimulator:
 
         LEDGER.reset()
         AUDIT.reset()
+        # The quality monitor's churn counters are process-global too
+        # (fed by the cache's evict/bind seams); a run starts them from
+        # zero, and reset() re-reads the KBT_QUALITY* env the run may
+        # have been launched under.
+        QUALITY.reset()
+        self._quality_enabled = QUALITY.enabled
         # Failover drill state: device-kind memo (successor instances
         # must re-stamp the 0.5 s solve budget their Scheduler
         # construction resets) and the kill switchboard.
@@ -421,6 +447,28 @@ class ClusterSimulator:
         self._running_since: Dict[str, int] = {}
         # Generate-mode future event queues (flap returns, recreations).
         self._scheduled: Dict[int, List[dict]] = {}
+        # Quality-card delta state, harness-owned: the scheduler's
+        # cadence-gated feed keeps its own (QUALITY._prev/_state), so
+        # the two delta streams never corrupt each other. The series
+        # dict keeps four floats per cycle for the end-of-run summary
+        # (bounded and tiny even at soak horizons); the card stream
+        # itself goes straight to disk.
+        self._quality_state: dict = {}
+        if self._quality_enabled:
+            # Swallow the process's pre-existing solver counter totals
+            # so the first card's solver deltas measure THIS run, not
+            # whatever ran earlier in the process — a replay in the
+            # same process must produce byte-identical cards.
+            from ..obs.quality import _solver_deltas
+
+            _solver_deltas(self._quality_state)
+        self._quality_churn: Dict[str, float] = {}
+        self._quality_series: Dict[str, List[float]] = {}
+        self._quality_file = None
+        if cfg.quality_out:
+            parent = os.path.dirname(os.path.abspath(cfg.quality_out))
+            os.makedirs(parent, exist_ok=True)
+            self._quality_file = open(cfg.quality_out, "w")
 
     # -- environment ---------------------------------------------------------
 
@@ -453,6 +501,9 @@ class ClusterSimulator:
             self._containment.set_result_tamper_hook(None)
             self._containment.configure(None)
             self.writer.close()
+            if self._quality_file is not None:
+                self._quality_file.close()
+                self._quality_file = None
             if self._tracing:
                 try:
                     self.report.trace_out = TRACER.export(
@@ -477,6 +528,7 @@ class ClusterSimulator:
             self._finish_integrity()
             self.report.breaker = self._containment.BREAKER.state_dict()
             self._finish_latency()
+            self._finish_quality()
             if cfg.soak:
                 self._finish_soak()
         finally:
@@ -894,6 +946,36 @@ class ClusterSimulator:
         metrics.register_sim_cycle()
         self.report.placements += len(placements)
 
+        # Per-cycle placement-quality card on the SETTLED world (the
+        # sim bypasses the production KBT_QUALITY_EVERY cadence — sim
+        # clusters are small). Churn deltas come from the process-
+        # global monitor's seam counters against the harness-owned
+        # prev, so the scheduler's own cadence feed stays untouched.
+        quality_card = None
+        if self._quality_enabled:
+            try:
+                quality_card = compute_scorecard(
+                    self.cache,
+                    churn=QUALITY.churn_delta(self._quality_churn),
+                    state=self._quality_state,
+                )
+            except Exception:
+                logger.exception("sim quality card failed")
+        if quality_card is not None:
+            for key, val in (
+                ("density_dom", quality_card["density_dom"]),
+                ("jain", quality_card["fairness"]["jain"]),
+                ("churn_per_placement",
+                 quality_card["churn"]["per_placement"]),
+                ("emptiable_frac",
+                 quality_card["frag"]["emptiable_frac"]),
+            ):
+                self._quality_series.setdefault(key, []).append(
+                    float(val)
+                )
+            if self._quality_file is not None:
+                self._quality_file.write(canon(quality_card) + "\n")
+
         stats = self._cycle_stats()
         if cfg.soak:
             # Soak-only series: invariant/error counts (bounded at zero
@@ -910,7 +992,7 @@ class ClusterSimulator:
                 # at the true trace cycle; the explicit index also
                 # realigns the counter for all later cycles.
                 TELEMETRY.observe_values({}, cycle=cycle)
-            TELEMETRY.annotate_cycle({
+            soak_values = {
                 "invariant_violations": float(len(violations)),
                 "sim_cycle_errors": 0.0 if ok else 1.0,
                 "placements": float(len(placements)),
@@ -919,7 +1001,13 @@ class ClusterSimulator:
                 "running": float(stats["running"]),
                 "nodes": float(stats["nodes"]),
                 "jobs": float(stats["jobs"]),
-            })
+            }
+            if quality_card is not None:
+                # quality:* series — the drift detectors (sim/soak.py)
+                # bound unfairness and churn-per-placement over the
+                # soak horizon.
+                soak_values.update(telemetry_values(quality_card))
+            TELEMETRY.annotate_cycle(soak_values)
 
         record = {
             "type": "cycle",
@@ -936,6 +1024,8 @@ class ClusterSimulator:
             record["failover"] = failover_info
         if integrity_delta is not None:
             record["integrity"] = integrity_delta
+        if quality_card is not None:
+            record["quality"] = quality_card
         self.writer.write(record)
         if self.replaying and rec is not None:
             if placements != rec.get("placements", []):
@@ -945,6 +1035,19 @@ class ClusterSimulator:
                 # the successor must classify, re-drive and evict
                 # identically, or the drill is not deterministic.
                 self.report.replay_mismatches.append(cycle)
+            elif (
+                quality_card is not None
+                and "quality" in rec
+                and replay_view(quality_card)
+                != replay_view(rec["quality"])
+            ):
+                # Minus the path-dependent solver deltas, a card is a
+                # pure function of the replayed cluster state: a
+                # mismatch means the replayed WORLD diverged even
+                # though the placements matched. (Traces recorded
+                # before the quality block, or under KBT_QUALITY=0 on
+                # either side, skip the comparison.)
+                self.report.quality_mismatches.append(cycle)
             # The integrity block is deliberately NOT byte-compared:
             # which CYCLE a gap confirmation / relist lands on depends
             # on the cluster's event-rv assignment order across
@@ -1095,6 +1198,31 @@ class ClusterSimulator:
                 self.report.audit_path = AUDIT.dump_jsonl(path)
             except OSError:
                 logger.exception("sim audit dump failed")
+
+    def _finish_quality(self) -> None:
+        """End of run: fold the per-cycle card series into the report's
+        quality summary — the medians are what the A/B study driver
+        (sim/study.py) pairs across seeds."""
+        series = self._quality_series
+        if not any(series.values()):
+            return
+        import statistics
+
+        summary: Dict[str, object] = {
+            key: {
+                "mean": round(statistics.fmean(vals), 6),
+                "median": round(statistics.median(vals), 6),
+                "last": round(vals[-1], 6),
+            }
+            for key, vals in sorted(series.items()) if vals
+        }
+        summary["cards"] = len(series.get("density_dom", ()))
+        summary["counters"] = {
+            k: round(v, 6) for k, v in QUALITY.counters().items()
+        }
+        if self.cfg.quality_out:
+            summary["stream"] = self.cfg.quality_out
+        self.report.quality = summary
 
     def _finish_soak(self) -> None:
         """End of a soak run: close the tail window, fit the leak/drift

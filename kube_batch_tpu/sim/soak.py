@@ -121,6 +121,25 @@ DRIFT_POLICY: Dict[str, DriftPolicy] = {
     "serving_slo_miss_rate": DriftPolicy(
         bound=0.02, patience=3, signed=False
     ),
+    # Placement-quality scorecard (obs/quality.py). Unfairness =
+    # 1 - Jain index over per-queue satisfaction ratios: transient
+    # imbalance is normal while gangs land, but a windowed mean past
+    # the bound for `patience` windows means the scheduler is
+    # systematically over-serving some queues — the drift the ROADMAP
+    # item-1 quality gate exists to catch. Bound is generous for the
+    # same reason fairness_drift's is: a trip must mean a regression,
+    # not one gang's worth of overshoot.
+    "quality:unfairness": DriftPolicy(
+        bound=0.5, patience=3, signed=False
+    ),
+    # Disruption churn: evictions + re-binds per placement over each
+    # scorecard interval. Steady-state churn near zero is the
+    # contract; a sustained windowed mean above 1.0 means the
+    # scheduler is thrashing (every placement paid for by more than
+    # one disruption).
+    "quality:churn_per_placement": DriftPolicy(
+        bound=1.0, patience=3, signed=False
+    ),
 }
 
 # Fraction of windows treated as warmup (jit compiles, pool growth).

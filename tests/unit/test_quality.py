@@ -1,0 +1,357 @@
+"""Placement-quality scorecard + study-harness tests
+(kube_batch_tpu/obs/quality.py, kube_batch_tpu/sim/study.py,
+doc/design/quality.md): Jain-index edge cases, the water-fill
+fragmentation primitives on hand-built matrices, churn
+preempt→re-bind classification, a full scorecard off the REAL cache
+(deterministic, replay_view strips the path-dependent solver block),
+the micro-cycle cadence pin, and the paired-study math
+(byte-deterministic artifact, gating verdict both ways)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.api import PodPhase, build_resource_list
+from kube_batch_tpu.cache import SchedulerCache
+from kube_batch_tpu.obs.quality import (
+    QUALITY,
+    QualityMonitor,
+    _emptiable_prefix,
+    _largest_placeable,
+    compute_scorecard,
+    jain_index,
+    replay_view,
+    telemetry_values,
+)
+from kube_batch_tpu.sim.study import (
+    PRESETS,
+    StudyConfig,
+    _quantile,
+    build_study,
+    render,
+)
+from kube_batch_tpu.sim.trace import canon
+from kube_batch_tpu.utils.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_quality():
+    QUALITY.reset()
+    yield
+    QUALITY.reset()
+
+
+def _cache():
+    return SchedulerCache(
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+
+
+# -- jain index --------------------------------------------------------------
+
+
+def test_jain_degenerate_inputs_are_defined():
+    # Empty, single-queue, and all-zero vectors are all perfectly fair
+    # by definition — never NaN.
+    assert jain_index([]) == 1.0
+    assert jain_index([0.7]) == 1.0
+    assert jain_index([0.0, 0.0, 0.0]) == 1.0
+
+
+def test_jain_equal_is_one_and_one_takes_all_is_inverse_n():
+    assert jain_index([0.5, 0.5, 0.5, 0.5]) == pytest.approx(1.0)
+    assert jain_index([1.0, 0.0, 0.0, 0.0]) == pytest.approx(0.25)
+    # Mild skew lands strictly between the extremes.
+    mid = jain_index([1.0, 0.5])
+    assert 0.25 < mid < 1.0
+
+
+# -- fragmentation primitives ------------------------------------------------
+
+
+def test_emptiable_prefix_water_fill():
+    eps = np.array([0.1])
+    # Three nodes (cpu only): used 1/3/8, idle 9/7/2. The least-loaded
+    # node (used 1) fits in the others' idle (9); adding the second
+    # (cum used 4) exceeds the remaining idle (2) — answer 1.
+    used = np.array([[1.0], [3.0], [8.0]])
+    idle = np.array([[9.0], [7.0], [2.0]])
+    assert _emptiable_prefix(used, idle, eps) == 1
+    # Tiny loads everywhere: all but one node drainable — the load has
+    # to live SOMEWHERE, so the last node is never emptiable.
+    used = np.array([[1.0], [1.0], [1.0]])
+    idle = np.array([[9.0], [9.0], [9.0]])
+    assert _emptiable_prefix(used, idle, eps) == 2
+    # Feasibility is per-dimension: cpu fits but memory blocks.
+    eps2 = np.array([0.1, 0.1])
+    used2 = np.array([[1.0, 50.0], [1.0, 50.0]])
+    idle2 = np.array([[9.0, 10.0], [9.0, 10.0]])
+    assert _emptiable_prefix(used2, idle2, eps2) == 0
+    assert _emptiable_prefix(
+        np.zeros((0, 1)), np.zeros((0, 1)), eps
+    ) == 0
+
+
+def test_largest_placeable_gang_floor_divide():
+    eps = np.array([0.1, 0.1])
+    idle = np.array([[4.0, 8.0], [2.0, 2.0]])
+    # Node 0 holds min(4/2, 8/2)=2 members, node 1 min(1, 1)=1.
+    assert _largest_placeable(idle, np.array([2.0, 2.0]), eps) == 3
+    # A request that asks for nothing measurable places nothing (the
+    # degenerate gang must not read as "infinite room").
+    assert _largest_placeable(idle, np.array([0.0, 0.0]), eps) == 0
+
+
+# -- churn monitor -----------------------------------------------------------
+
+
+def test_churn_preempt_then_rebind_classification():
+    mon = QualityMonitor()
+    mon.note_eviction("u1", "preempt")
+    mon.note_eviction("u2", "node-death")
+    # u1 re-binds (churn paid back), u3 is a fresh placement.
+    mon.note_bound(["u1", "u3"])
+    counters = mon.counters()
+    assert counters["evictions"] == 2.0
+    assert counters["preemptions"] == 1.0
+    assert counters["rebinds"] == 1.0
+    assert counters["placements"] == 2.0
+    assert mon.evictions_by_reason == {"preempt": 1, "node-death": 1}
+
+
+def test_churn_delta_is_caller_owned():
+    mon = QualityMonitor()
+    mon.note_eviction("u1", "preempt")
+    mon.note_bound(["u1"])
+    prev = {}
+    first = mon.churn_delta(prev)
+    assert first["evictions"] == 1.0 and first["rebinds"] == 1.0
+    # Same prev again: nothing new happened, the delta is zero — and a
+    # SECOND caller with its own prev still sees the full history.
+    assert all(v == 0.0 for v in mon.churn_delta(prev).values())
+    other = {}
+    assert mon.churn_delta(other)["evictions"] == 1.0
+
+
+# -- scorecard off the real cache --------------------------------------------
+
+
+def _built_cache():
+    cache = _cache()
+    cache.add_queue(build_queue("q0", weight=1))
+    cache.add_queue(build_queue("q1", weight=1))
+    for name in ("n0", "n1"):
+        cache.add_node(build_node(
+            name, build_resource_list(cpu="8", memory="32Gi", pods=110),
+        ))
+    cache.add_pod_group(build_pod_group(
+        "pgr", namespace="ns", min_member=1, queue="q0",
+    ))
+    cache.add_pod(build_pod(
+        "ns", "pgr-p0", "n0", PodPhase.RUNNING,
+        build_resource_list(cpu="2", memory="4Gi"),
+        group_name="pgr",
+    ))
+    cache.add_pod_group(build_pod_group(
+        "pgp", namespace="ns", min_member=3, queue="q1",
+    ))
+    for i in range(3):
+        cache.add_pod(build_pod(
+            "ns", f"pgp-p{i}", "", PodPhase.PENDING,
+            build_resource_list(cpu="1", memory="1Gi"),
+            group_name="pgp",
+        ))
+    return cache
+
+
+def test_scorecard_shape_and_values():
+    cache = _built_cache()
+    try:
+        card = compute_scorecard(cache)
+        assert card["nodes"] == 2 and card["queues"] == 2
+        # 2 of 16 cpus used; cpu is the dominant dimension here.
+        assert card["density"]["cpu"] == pytest.approx(0.125)
+        assert card["density_dom"] == pytest.approx(0.125)
+        # n1 is empty; n0 is NOT emptiable — moving its load onto the
+        # empty n1 would just swap which node is empty (no
+        # consolidation gain), so empty nodes are not drain targets.
+        assert card["frag"]["empty_nodes"] == 1
+        assert card["frag"]["emptiable_nodes"] == 1
+        assert card["frag"]["emptiable_frac"] == pytest.approx(0.5)
+        # q1's pending gang could land many 1-cpu members right now.
+        assert card["frag"]["largest_gang"]["q1"] >= 3
+        assert "q0" not in card["frag"]["largest_gang"]
+        # One queue holds everything it deserves, the other nothing
+        # it is owed yet — fairness is measured, not degenerate.
+        assert 0.0 < card["fairness"]["jain"] <= 1.0
+        assert set(card["fairness"]["distance"]) == {"q0", "q1"}
+        assert card["churn"]["per_placement"] == 0.0
+    finally:
+        cache.shutdown()
+
+
+def test_scorecard_deterministic_and_replay_view_strips_solver():
+    cache = _built_cache()
+    try:
+        one = compute_scorecard(cache, state={})
+        two = compute_scorecard(cache, state={})
+        assert canon(one) == canon(two)
+        view = replay_view(one)
+        assert "solver" in one and "solver" not in view
+        assert view["density"] == one["density"]
+        assert replay_view(None) is None
+    finally:
+        cache.shutdown()
+
+
+def test_telemetry_values_flatten():
+    cache = _built_cache()
+    try:
+        values = telemetry_values(compute_scorecard(cache))
+        assert values["quality:density_dom"] == pytest.approx(0.125)
+        assert values["quality:unfairness"] == pytest.approx(
+            1.0 - values["quality:fairness_jain"]
+        )
+        assert "quality:churn_per_placement" in values
+        assert "quality:empty_nodes" in values
+    finally:
+        cache.shutdown()
+
+
+# -- production cadence: micro cycles count ----------------------------------
+
+
+def test_micro_cycles_advance_the_card_cadence(monkeypatch):
+    """Micro cycles count toward KBT_QUALITY_EVERY — and toward the
+    telemetry probe cadence (the per-queue fairness probe included).
+    Both were already true at HEAD (run_micro feeds
+    TELEMETRY.observe_scheduler_cycle and QUALITY.annotate_cycle the
+    same way run_once does); this test PINS the behavior so a future
+    refactor cannot reintroduce the stale-gauge failure mode: under
+    the micro-primary steady state (PR 17), a probe counting only
+    periodic cycles can go many minutes stale. With every=2, the
+    second card lands on a MICRO cycle's flight record."""
+    from kube_batch_tpu.obs import telemetry
+    from kube_batch_tpu.obs.flightrecorder import RECORDER
+    from kube_batch_tpu.obs.telemetry import TELEMETRY
+    from kube_batch_tpu.scheduler import Scheduler
+
+    monkeypatch.setenv("KBT_QUALITY_EVERY", "2")
+    monkeypatch.setattr(telemetry, "FAIRNESS_EVERY", 1)
+    QUALITY.reset()
+    assert QUALITY.every == 2
+    cache = _built_cache()
+    conf = (
+        'actions: "allocate_tpu"\n'
+        "tiers:\n"
+        "- plugins:\n"
+        "  - name: priority\n"
+        "  - name: gang\n"
+        "  - name: conformance\n"
+        "- plugins:\n"
+        "  - name: drf\n"
+        "  - name: predicates\n"
+        "  - name: proportion\n"
+        "  - name: nodeorder\n"
+    )
+    sched = Scheduler(cache, scheduler_conf=conf)
+    try:
+        sched.run_once()          # cycle 0 -> card 1 (periodic)
+        assert cache.wait_for_side_effects(timeout=30.0)
+        observed_before = TELEMETRY.cycles_observed
+        sched.run_micro()         # cycle 1 -> off-cadence
+        sched.run_micro()         # cycle 2 -> card 2, on a micro record
+        snap = QUALITY.snapshot()
+        assert snap["cycles_seen"] == 3
+        assert snap["cards_computed"] == 2
+        micro_rec = [
+            r for r in RECORDER.snapshot()
+            if r.get("cycle_kind") == "micro"
+        ][-1]
+        assert micro_rec["quality"]["nodes"] == 2
+        # The telemetry feed (fairness probe cadence included) advanced
+        # on the micro cycles, and the probe itself ran on one.
+        assert TELEMETRY.cycles_observed == observed_before + 2
+        last_sample = TELEMETRY._raw[-1]
+        assert any(
+            key.startswith("fairness_drift:") for key in last_sample
+        )
+    finally:
+        cache.shutdown()
+
+
+def test_disabled_feed_is_inert(monkeypatch):
+    monkeypatch.setenv("KBT_QUALITY", "0")
+    QUALITY.reset()
+    assert not QUALITY.enabled
+    cache = _built_cache()
+    try:
+        assert QUALITY.annotate_cycle(cache) is None
+        assert QUALITY.snapshot()["cards_computed"] == 0
+    finally:
+        cache.shutdown()
+
+
+# -- paired study math -------------------------------------------------------
+
+
+def test_quantile_interpolates():
+    assert _quantile([], 0.5) == 0.0
+    assert _quantile([3.0], 0.5) == 3.0
+    assert _quantile([1.0, 2.0, 3.0, 4.0], 0.5) == pytest.approx(2.5)
+    assert _quantile([1.0, 2.0, 3.0, 4.0], 0.25) == pytest.approx(1.75)
+
+
+def _fake_runner(density_bump_b=0.02):
+    def runner(cfg, preset, arm, seed):
+        bump = density_bump_b if arm.name == preset.b.name else 0.0
+        return {
+            "placements": 100 + seed,
+            "quality": {
+                "density_dom": {"median": 0.5 + seed * 0.01 + bump},
+                "jain": {"median": 0.9},
+                "churn_per_placement": {"median": 0.1},
+                "emptiable_frac": {"median": 0.3},
+            },
+        }
+
+    return runner
+
+
+def test_study_artifact_is_byte_deterministic():
+    cfg = StudyConfig(preset="twolevel", seeds=range(4), workers=3)
+    one = render(build_study(cfg, runner=_fake_runner()))
+    two = render(build_study(cfg, runner=_fake_runner()))
+    assert one == two
+    study = json.loads(one)
+    assert study["config"]["seeds"] == [0, 1, 2, 3]
+    assert len(study["per_seed"]) == 4
+    for row in study["per_seed"]:
+        assert row["delta"]["density_dom"] == pytest.approx(0.02)
+        assert row["delta"]["placements"] == 0.0
+    assert study["summary"]["density_dom"]["median"] == pytest.approx(
+        0.02
+    )
+
+
+def test_study_verdict_gates_both_ways():
+    cfg = StudyConfig(preset="twolevel", seeds=range(3), workers=1)
+    preset = PRESETS["twolevel"]
+    win = build_study(cfg, runner=_fake_runner(0.0))["verdict"]
+    assert win["pass"] and win["verdict"] == preset.keep
+    # B loses 5 points of density: past DENSITY_TOL, verdict flips.
+    lose = build_study(cfg, runner=_fake_runner(-0.05))["verdict"]
+    assert not lose["pass"] and lose["verdict"] == preset.revisit

@@ -81,6 +81,15 @@ POLICY: List[Tuple[str, str, float, str]] = [
     ("obs.tracer_overhead_pct", "lower", 10.0, "ratio"),
     ("obs.telemetry_overhead_pct", "lower", 10.0, "ratio"),
     ("obs.latency_overhead_pct", "lower", 10.0, "ratio"),
+    # Placement-quality scorecard (PR 20, obs/quality.py): amortized
+    # per-cycle cost must stay a rounding error of the warm steady
+    # cycle (<1% budget; 10% here is the regression tripwire, not the
+    # target), and the raw card stays cheap in absolute terms. The
+    # headline packing-density at the benched 50k x 5k shape may not
+    # silently collapse — density is machine-independent (ratio).
+    ("quality.overhead_pct_of_steady", "lower", 10.0, "ratio"),
+    ("quality.card_ms", "lower", 0.5, "med"),
+    ("quality.density_dom", "higher", 0.2, "ratio"),
     # Placement-latency SLI mixes (PR 14): VIRTUAL-time p99s off the
     # seeded deterministic sim — machine-independent (ratio kind, no
     # canary), so a climb is a scheduling-delay regression by
